@@ -1,0 +1,37 @@
+"""Test environment: CPU backend with 8 virtual devices, x64 enabled.
+
+This is the "fake backend" the reference lacks (SURVEY.md section 4): the
+sharded solver's multi-chip semantics are exercised on an 8-device CPU mesh
+(`--xla_force_host_platform_device_count=8`) without TPU hardware, and f64 is
+available for parity against the native C++ oracle.
+
+Must run before jax is imported anywhere, hence the env mutation at module
+import time (pytest imports conftest first).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+from wavetpu.core.problem import Problem  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def small_problem():
+    return Problem(N=16, Np=1, Lx=1.0, Ly=1.0, Lz=1.0, T=1.0, timesteps=10)
+
+
+@pytest.fixture(scope="session")
+def medium_problem():
+    return Problem(N=32, Np=1, Lx=1.0, Ly=1.0, Lz=1.0, T=1.0, timesteps=20)

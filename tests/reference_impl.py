@@ -1,0 +1,102 @@
+"""Independent numpy implementation of the reference *scheme* in the
+reference's own indexing: an (N+1)^3 grid with a duplicated periodic seam
+node in x and explicit Dirichlet faces in y/z.
+
+Written from the numerical scheme described in SURVEY.md section 0 (leapfrog +
+7-point Laplacian, Taylor half-step bootstrap, seam update with first-step
+coefficients), NOT ported from the C++ sources.  Its purpose is to pin the
+framework's fundamental-domain (N,N,N) formulation to the reference's
+(N+1)^3-with-seam formulation: tests assert the two agree to rounding error,
+which proves the seam-free design is the same scheme.
+
+Deliberately slow and obvious; f64; small N only.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from wavetpu.core.problem import Problem
+from wavetpu.verify.oracle import full_analytic_grid
+
+
+def _interior_lap(v: np.ndarray, p: Problem) -> np.ndarray:
+    """7-pt Laplacian on interior points [1..N-1]^3 of an (N+1)^3 layer."""
+    c = v[1:-1, 1:-1, 1:-1]
+    return (
+        (v[2:, 1:-1, 1:-1] - 2 * c + v[:-2, 1:-1, 1:-1]) / p.hx**2
+        + (v[1:-1, 2:, 1:-1] - 2 * c + v[1:-1, :-2, 1:-1]) / p.hy**2
+        + (v[1:-1, 1:-1, 2:] - 2 * c + v[1:-1, 1:-1, :-2]) / p.hz**2
+    )
+
+
+def _seam_lap(v: np.ndarray, p: Problem) -> np.ndarray:
+    """Laplacian on the x = N seam plane, interior (j,k), with the periodic
+    wrap: x-neighbours are N-1 and 1 (node 0 duplicates node N)."""
+    N = v.shape[0] - 1
+    c = v[N, 1:-1, 1:-1]
+    return (
+        (v[N - 1, 1:-1, 1:-1] - 2 * c + v[1, 1:-1, 1:-1]) / p.hx**2
+        + (v[N, 2:, 1:-1] - 2 * c + v[N, :-2, 1:-1]) / p.hy**2
+        + (v[N, 1:-1, 2:] - 2 * c + v[N, 1:-1, :-2]) / p.hz**2
+    )
+
+
+def _zero_faces(layer: np.ndarray) -> None:
+    N = layer.shape[0] - 1
+    layer[:, 0, :] = 0.0
+    layer[:, N, :] = 0.0
+    layer[:, :, 0] = 0.0
+    layer[:, :, N] = 0.0
+
+
+def solve_reference(p: Problem) -> np.ndarray:
+    """Full history (timesteps+1, N+1, N+1, N+1), float64."""
+    N, ts = p.N, p.timesteps
+    a2t2 = p.a2 * p.tau * p.tau
+    u = np.zeros((ts + 1, N + 1, N + 1, N + 1), dtype=np.float64)
+
+    # layer 0: analytic everywhere
+    u[0] = full_analytic_grid(p, 0)
+
+    # layer 1: zero faces, seam half-step, interior half-step
+    _zero_faces(u[1])
+    u[1][N, 1:-1, 1:-1] = u[0][N, 1:-1, 1:-1] + 0.5 * a2t2 * _seam_lap(u[0], p)
+    u[1][0, 1:-1, 1:-1] = u[1][N, 1:-1, 1:-1]
+    u[1][1:-1, 1:-1, 1:-1] = u[0][1:-1, 1:-1, 1:-1] + 0.5 * a2t2 * _interior_lap(
+        u[0], p
+    )
+    _zero_faces(u[1])  # faces of the seam planes stay zero
+
+    # layers n >= 2: leapfrog
+    for n in range(2, ts + 1):
+        _zero_faces(u[n])
+        u[n][N, 1:-1, 1:-1] = (
+            2 * u[n - 1][N, 1:-1, 1:-1]
+            - u[n - 2][N, 1:-1, 1:-1]
+            + a2t2 * _seam_lap(u[n - 1], p)
+        )
+        u[n][0, 1:-1, 1:-1] = u[n][N, 1:-1, 1:-1]
+        u[n][1:-1, 1:-1, 1:-1] = (
+            2 * u[n - 1][1:-1, 1:-1, 1:-1]
+            - u[n - 2][1:-1, 1:-1, 1:-1]
+            + a2t2 * _interior_lap(u[n - 1], p)
+        )
+    return u
+
+
+def reference_errors(p: Problem, history: np.ndarray):
+    """Post-hoc per-layer L-inf abs/rel errors over interior [1..N-1]^3,
+    the reference's `calculate_error` metric."""
+    ts = history.shape[0] - 1
+    abs_e = np.zeros(ts + 1)
+    rel_e = np.zeros(ts + 1)
+    for n in range(ts + 1):
+        f = full_analytic_grid(p, n)
+        d = np.abs(history[n] - f)[1:-1, 1:-1, 1:-1]
+        abs_e[n] = d.max()
+        with np.errstate(divide="ignore", invalid="ignore"):
+            r = d / np.abs(f)[1:-1, 1:-1, 1:-1]
+        r = np.where(np.isnan(r), 0.0, r)
+        rel_e[n] = r.max()
+    return abs_e, rel_e

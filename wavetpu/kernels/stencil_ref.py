@@ -1,0 +1,83 @@
+"""Pure-jnp leapfrog + 7-point Laplacian stencil (the semantic reference).
+
+This is the XLA-fused counterpart of the reference's hot loops
+(openmp_sol.cpp:157-163 interior leapfrog, openmp_sol.cpp:56-63 `Grid::laplace`,
+cuda_sol_kernels.cu:24-47 `calculate_layer`).  Everything is expressed as
+cyclic rolls, which is exact because of the state representation documented in
+`wavetpu.core.problem`:
+
+ * x is the fundamental periodic domain, so rolls ARE the boundary condition
+   (the reference's seam `prepare_layer` update, openmp_sol.cpp:114-120, is
+   the same formula with a wrapped neighbour).
+ * y/z hold the Dirichlet invariant u[:,0,:] = u[:,:,0] = 0, so a cyclic roll
+   delivers the correct zero neighbour for the j = N-1 / k = N-1 planes, and
+   the j=0 / k=0 planes themselves are re-zeroed after each update (the
+   counterpart of the reference zeroing all four y/z faces each step,
+   openmp_sol.cpp:104-112).
+
+The Pallas kernel in `stencil_pallas.py` must agree with this module bitwise
+on identical inputs (tested in tests/test_pallas.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from wavetpu.core.problem import Problem
+
+
+def laplacian(u, inv_h2):
+    """7-point Laplacian with cyclic shifts on all three axes."""
+    ix, iy, iz = inv_h2
+    lap = (jnp.roll(u, 1, 0) + jnp.roll(u, -1, 0) - 2.0 * u) * ix
+    lap = lap + (jnp.roll(u, 1, 1) + jnp.roll(u, -1, 1) - 2.0 * u) * iy
+    lap = lap + (jnp.roll(u, 1, 2) + jnp.roll(u, -1, 2) - 2.0 * u) * iz
+    return lap
+
+
+def apply_dirichlet(u):
+    """Re-impose the Dirichlet invariant: zero the stored y=0 and z=0 planes.
+
+    (The y=N / z=N planes are not stored; see problem.py.)
+    """
+    u = u.at[:, 0, :].set(0.0)
+    u = u.at[:, :, 0].set(0.0)
+    return u
+
+
+def leapfrog_step(u_prev, u, problem: Problem):
+    """u_next = 2u - u_prev + a^2 tau^2 lap(u), Dirichlet re-imposed.
+
+    The uniform interior update of the reference (openmp_sol.cpp:160) which,
+    on the fundamental domain, also covers the periodic seam.
+    """
+    c = jnp.asarray(problem.a2tau2, dtype=u.dtype)
+    u_next = 2.0 * u - u_prev + c * laplacian(u, problem.inv_h2)
+    return apply_dirichlet(u_next)
+
+
+def taylor_half_step(u0, problem: Problem):
+    """Layer-1 bootstrap: u1 = u0 + (a^2 tau^2 / 2) lap(u0)  (uses u_t(0)=0).
+
+    Reference: openmp_sol.cpp:137-144 and the seam's n==1 coefficients at
+    openmp_sol.cpp:117 (factor 1 on u0, none on u^{-1}, half on the Laplacian),
+    which are exactly this formula.
+    """
+    c = jnp.asarray(0.5 * problem.a2tau2, dtype=u0.dtype)
+    u1 = u0 + c * laplacian(u0, problem.inv_h2)
+    return apply_dirichlet(u1)
+
+
+def laplacian_ext(ext, inv_h2):
+    """7-point Laplacian of the interior of a halo-extended block.
+
+    `ext` has one ghost cell on each side of each axis: shape (bx+2, by+2,
+    bz+2); the result has shape (bx, by, bz).  Used by the sharded solver
+    where ghost planes arrive via `ppermute` instead of rolls.
+    """
+    ix, iy, iz = inv_h2
+    c = ext[1:-1, 1:-1, 1:-1]
+    lap = (ext[:-2, 1:-1, 1:-1] + ext[2:, 1:-1, 1:-1] - 2.0 * c) * ix
+    lap = lap + (ext[1:-1, :-2, 1:-1] + ext[1:-1, 2:, 1:-1] - 2.0 * c) * iy
+    lap = lap + (ext[1:-1, 1:-1, :-2] + ext[1:-1, 1:-1, 2:] - 2.0 * c) * iz
+    return lap

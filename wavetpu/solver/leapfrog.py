@@ -1,0 +1,190 @@
+"""Single-device time-stepping driver.
+
+Replaces the reference's `calculate_start` + `calculate_num_sol` loops
+(openmp_sol.cpp:123-167, mpi_new.cpp:271-372) with one jitted program:
+layer-0/1 bootstrap followed by a `lax.scan` over the remaining steps.
+
+Design notes (TPU-first, not a translation):
+
+ * The reference rotates three buffers `grids[n % 3]` (mpi_new.cpp:131,338).
+   In functional JAX the scan carry is simply (u_prev, u_cur) - two live
+   buffers, with XLA double-buffering the output of each step.
+ * The reference's fused error path re-evaluates the analytic solution with
+   three sines per point per step (mpi_new.cpp:340).  Here the separable
+   oracle (verify/oracle.py) reduces that to broadcasted 1-D factors.
+ * Per-layer L-inf errors are accumulated as scan outputs, the analog of
+   `max_abs_errors.push_back` (mpi_new.cpp:350) - no host round-trips inside
+   the loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from wavetpu.core.problem import Problem
+from wavetpu.kernels import stencil_ref
+from wavetpu.verify import oracle
+
+
+@dataclasses.dataclass
+class SolveResult:
+    problem: Problem
+    u_prev: jax.Array          # layer timesteps-1 (fundamental (N,N,N) domain)
+    u_cur: jax.Array           # layer timesteps
+    abs_errors: np.ndarray     # per-layer L-inf abs error, shape (timesteps+1,)
+    rel_errors: np.ndarray     # per-layer L-inf rel error, shape (timesteps+1,)
+    init_seconds: float = 0.0
+    solve_seconds: float = 0.0
+
+    @property
+    def gcells_per_second(self) -> float:
+        total = self.problem.cells_per_step * self.problem.timesteps
+        return total / self.solve_seconds / 1e9 if self.solve_seconds else 0.0
+
+
+def _error_fn(problem: Problem, dtype):
+    """Returns (u, n) -> (abs_e, rel_e) with precomputed factors closed over."""
+    sx, sy, sz = oracle.spatial_factors(problem, dtype)
+    ct_table = oracle.time_factor_table(problem, dtype)
+    mask = jnp.asarray(oracle.interior_masks_1d(problem.N))
+
+    def errors(u, n):
+        ct = ct_table[n]
+        f = oracle.analytic_field(sx, sy, sz, ct)
+        return oracle.layer_errors(u, f, mask, mask, mask)
+
+    return errors
+
+
+def initial_state(problem: Problem, dtype=jnp.float32) -> Tuple[jax.Array, jax.Array]:
+    """Layers 0 and 1: analytic init + Taylor half-step.
+
+    Reference: `calculate_start` (openmp_sol.cpp:123-145).  Layer 0 fills the
+    whole grid from the analytic solution; layer 1 is the half-step
+    u1 = u0 + (a^2 tau^2 / 2) lap(u0), with boundary planes re-imposed.
+    """
+    sx, sy, sz = oracle.spatial_factors(problem, dtype)
+    ct0 = oracle.time_factor(problem, 0, dtype)
+    u0 = oracle.analytic_field(sx, sy, sz, ct0)
+    u0 = stencil_ref.apply_dirichlet(u0)
+    u1 = stencil_ref.taylor_half_step(u0, problem)
+    return u0, u1
+
+
+def make_solver(
+    problem: Problem,
+    dtype=jnp.float32,
+    step_fn: Optional[Callable] = None,
+    compute_errors: bool = True,
+) -> Callable[[], Tuple[jax.Array, jax.Array, jax.Array, jax.Array]]:
+    """Build the jitted end-to-end solver (no runtime array inputs).
+
+    `step_fn(u_prev, u, problem) -> u_next` defaults to the jnp-roll stencil;
+    the Pallas kernel slots in via the same signature.
+    """
+    step = step_fn or stencil_ref.leapfrog_step
+    errors = _error_fn(problem, dtype)
+    nsteps = problem.timesteps
+
+    def run():
+        u0, u1 = initial_state(problem, dtype)
+        if compute_errors:
+            a0, r0 = errors(u0, 0)
+            a1, r1 = errors(u1, 1)
+        else:
+            a0 = r0 = a1 = r1 = jnp.zeros((), dtype)
+
+        def body(carry, n):
+            u_prev, u = carry
+            u_next = step(u_prev, u, problem)
+            if compute_errors:
+                ae, re = errors(u_next, n)
+            else:
+                ae = re = jnp.zeros((), dtype)
+            return (u, u_next), (ae, re)
+
+        (u_prev, u_cur), (abs_t, rel_t) = jax.lax.scan(
+            body, (u0, u1), jnp.arange(2, nsteps + 1)
+        )
+        abs_all = jnp.concatenate([jnp.stack([a0, a1]), abs_t])
+        rel_all = jnp.concatenate([jnp.stack([r0, r1]), rel_t])
+        return u_prev, u_cur, abs_all, rel_all
+
+    return jax.jit(run)
+
+
+def solve(
+    problem: Problem,
+    dtype=jnp.float32,
+    step_fn: Optional[Callable] = None,
+    compute_errors: bool = True,
+) -> SolveResult:
+    """Compile + run, with the reference's two timing phases.
+
+    "grids initialized in Xms" maps to compile time here (state allocation is
+    part of the program); "numerical solution calculated in Xms" is the
+    execution wall time (mpi_new.cpp:472-474, 354-357).
+    """
+    t0 = time.perf_counter()
+    runner = make_solver(problem, dtype, step_fn, compute_errors)
+    lowered = runner.lower().compile()
+    t1 = time.perf_counter()
+    u_prev, u_cur, abs_all, rel_all = lowered()
+    jax.block_until_ready((u_prev, u_cur, abs_all, rel_all))
+    t2 = time.perf_counter()
+    return SolveResult(
+        problem=problem,
+        u_prev=u_prev,
+        u_cur=u_cur,
+        abs_errors=np.asarray(abs_all, dtype=np.float64),
+        rel_errors=np.asarray(rel_all, dtype=np.float64),
+        init_seconds=t1 - t0,
+        solve_seconds=t2 - t1,
+    )
+
+
+def solve_history(problem: Problem, dtype=jnp.float32) -> np.ndarray:
+    """Full time history (timesteps+1, N, N, N) - the openmp_sol storage model.
+
+    The reference OpenMP/mpi_sol variants keep every layer in memory and
+    compute errors post hoc (openmp_sol.cpp:216-219, 169-190).  Provided for
+    parity testing and small-N debugging; O(T * N^3) memory.
+    """
+
+    @jax.jit
+    def run():
+        u0, u1 = initial_state(problem, dtype)
+
+        def body(carry, _):
+            u_prev, u = carry
+            u_next = stencil_ref.leapfrog_step(u_prev, u, problem)
+            return (u, u_next), u_next
+
+        _, rest = jax.lax.scan(
+            body, (u0, u1), None, length=problem.timesteps - 1
+        )
+        return jnp.concatenate([jnp.stack([u0, u1]), rest])
+
+    return np.asarray(run())
+
+
+def to_reference_grid(u: np.ndarray) -> np.ndarray:
+    """Expand a fundamental-domain (N,N,N) field to the reference's (N+1)^3.
+
+    Re-attaches the duplicated periodic seam plane x=N (= x=0) and the zero
+    Dirichlet planes y=N, z=N, giving index-for-index comparability with the
+    reference's `Grid` layout (openmp_sol.cpp:44-50).
+    """
+    u = np.asarray(u)
+    n = u.shape[0]
+    out = np.zeros((n + 1, n + 1, n + 1), dtype=u.dtype)
+    out[:n, :n, :n] = u
+    out[n, :n, :n] = u[0]
+    return out
