@@ -5,13 +5,18 @@ sharded solver's multi-chip semantics are exercised on an 8-device CPU mesh
 (`--xla_force_host_platform_device_count=8`) without TPU hardware, and f64 is
 available for parity against the native C++ oracle.
 
-Must run before jax is imported anywhere, hence the env mutation at module
-import time (pytest imports conftest first).
+Hermeticity note: this image pre-imports jax at interpreter startup (a
+sitecustomize hook registering the TPU PJRT plugin) and exports
+JAX_PLATFORMS=tpu-ish, so mutating that env var here is too late.  Backend
+*initialization* is lazy, however, so `jax.config.update("jax_platforms")`
+plus an XLA_FLAGS mutation (both read at first backend creation) pin the
+suite to CPU regardless of the caller's environment.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# XLA_FLAGS is read when the CPU client is created (lazily), so mutating it
+# here is still early enough even though jax is already imported.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -20,11 +25,18 @@ if "--xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import pytest  # noqa: E402
 
 from wavetpu.core.problem import Problem  # noqa: E402
+
+
+def pytest_sessionstart(session):
+    devs = jax.devices()
+    assert devs[0].platform == "cpu", f"suite must run on CPU, got {devs}"
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
 
 
 @pytest.fixture(scope="session")

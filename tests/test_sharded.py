@@ -1,0 +1,105 @@
+"""M1 gates: the sharded solver equals the single-device solver.
+
+Runs on the 8-virtual-CPU-device mesh (conftest.py) - the "fake backend" the
+reference lacks (SURVEY.md section 4): multi-chip semantics without a pod.
+Parity target: `solver.leapfrog` (itself pinned layer-by-layer to the
+independent (N+1)^3 seam formulation in tests/reference_impl.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wavetpu.core.grid import Topology, choose_mesh_shape
+from wavetpu.core.problem import Problem
+from wavetpu.solver import leapfrog, sharded
+
+MESHES = [(1, 1, 1), (2, 2, 2), (1, 2, 4), (8, 1, 1), (1, 1, 8), (2, 1, 2)]
+
+
+def _parity(problem, mesh_shape, dtype=jnp.float64, atol=1e-12):
+    single = leapfrog.solve(problem, dtype=dtype)
+    multi = sharded.solve_sharded(problem, mesh_shape=mesh_shape, dtype=dtype)
+    uS = np.asarray(single.u_cur)
+    uM = sharded.gather_fundamental(multi.u_cur, problem)
+    np.testing.assert_allclose(uM, uS, atol=atol, rtol=0.0)
+    uSp = np.asarray(single.u_prev)
+    uMp = sharded.gather_fundamental(multi.u_prev, problem)
+    np.testing.assert_allclose(uMp, uSp, atol=atol, rtol=0.0)
+    np.testing.assert_allclose(
+        multi.abs_errors, single.abs_errors, atol=atol, rtol=0.0
+    )
+    np.testing.assert_allclose(
+        multi.rel_errors, single.rel_errors, atol=1e-9, rtol=1e-9
+    )
+    return single, multi
+
+
+@pytest.mark.parametrize("mesh_shape", MESHES)
+def test_sharded_matches_single_device(small_problem, mesh_shape):
+    """Sharded == single-device across mesh shapes, including the periodic
+    x seam crossing shard boundaries (8,1,1) and every-axis-cyclic cases."""
+    _parity(small_problem, mesh_shape)
+
+
+def test_sharded_uneven_grid():
+    """N not divisible by the mesh dims: pad cells are masked out and the
+    seam index arithmetic (comm/halo.py) keeps the wrap exact - the analog
+    of the reference's remainder-rank folding (mpi_sol.cpp:417-421)."""
+    p = Problem(N=17, timesteps=8)
+    _parity(p, (2, 2, 2))
+    _parity(p, (4, 1, 2))
+
+
+def test_sharded_uneven_last_shard_single_plane():
+    """Last shard owns exactly one real plane (r_last == 1)."""
+    p = Problem(N=13, timesteps=6)
+    # block = ceil(13/4) = 4, last shard owns 13 - 3*4 = 1 plane.
+    _parity(p, (4, 1, 1))
+    _parity(p, (1, 4, 1))
+
+
+def test_sharded_pad_cells_stay_zero(small_problem):
+    res = sharded.solve_sharded(
+        Problem(N=15, timesteps=6), mesh_shape=(2, 2, 2), dtype=jnp.float64
+    )
+    u = np.asarray(res.u_cur)
+    assert u.shape == (16, 16, 16)
+    assert np.all(u[15:] == 0.0)
+    assert np.all(u[:, 15:] == 0.0)
+    assert np.all(u[:, :, 15:] == 0.0)
+
+
+def test_sharded_f32(small_problem):
+    """The production dtype path agrees with single-device f32 bitwise-ish
+    (same op order per cell; halo vs roll may differ in fusion, so allow
+    tiny tolerance)."""
+    _parity(small_problem, (2, 2, 2), dtype=jnp.float32, atol=1e-6)
+
+
+def test_sharded_errors_bounded(medium_problem):
+    res = sharded.solve_sharded(
+        medium_problem, mesh_shape=(2, 2, 2), dtype=jnp.float64
+    )
+    assert res.abs_errors[0] == 0.0
+    assert res.abs_errors.max() < 1e-2
+    assert np.isfinite(res.abs_errors).all()
+
+
+def test_topology_validation():
+    with pytest.raises(ValueError):
+        Topology(N=10, mesh_shape=(8, 1, 1))  # last shard would own <1 plane
+    t = Topology(N=17, mesh_shape=(2, 2, 2))
+    assert t.block == (9, 9, 9)
+    assert t.padded == (18, 18, 18)
+    assert t.r_last == (8, 8, 8)
+
+
+def test_choose_mesh_shape():
+    assert choose_mesh_shape(8) == (2, 2, 2)
+    assert choose_mesh_shape(64) == (4, 4, 4)
+    assert sorted(choose_mesh_shape(4), reverse=True) == [2, 2, 1]
+    assert choose_mesh_shape(1) == (1, 1, 1)
+    mx, my, mz = choose_mesh_shape(12)
+    assert mx * my * mz == 12

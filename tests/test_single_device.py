@@ -68,10 +68,22 @@ def test_fused_errors_match_posthoc(small_problem, ref_history):
         np.testing.assert_allclose(r1, r2, rtol=1e-6, atol=1e-12)
 
 
-def test_layer0_error_is_zero(small_problem):
-    res = leapfrog.solve(small_problem, dtype=jnp.float64)
-    assert res.abs_errors[0] == 0.0
-    assert res.rel_errors[0] == 0.0
+def test_layer0_error_is_zero(small_problem, medium_problem):
+    """The reported layer-0 error is zero by definition (leapfrog.py), so
+    additionally pin the *actual* layer-0 state against the host-f64 oracle
+    - otherwise the definitional zero could mask a broken bootstrap."""
+    from wavetpu.verify import oracle
+
+    for p in (small_problem, medium_problem):
+        res = leapfrog.solve(p, dtype=jnp.float64)
+        assert res.abs_errors[0] == 0.0
+        assert res.rel_errors[0] == 0.0
+        hist = leapfrog.solve_history(p, dtype=jnp.float64)
+        f0 = oracle.full_analytic_grid(p, 0)[:-1, :-1, :-1]
+        f0[:, 0, :] = 0.0
+        f0[:, :, 0] = 0.0
+        true_layer0_err = np.abs(np.asarray(hist[0]) - f0).max()
+        assert true_layer0_err < 1e-14, true_layer0_err
 
 
 def test_dirichlet_invariant(small_problem):

@@ -1,0 +1,108 @@
+"""Sharded state and 3D device topology.
+
+The TPU-native counterpart of the reference's L0 layer: `MPI_Dims_create` 3D
+factorization, per-rank extents with the remainder folded into the last rank,
+and ghost-cell padding (reference: mpi_sol.cpp:405-459, mpi_new.cpp:409-423,
+cuda_sol.cpp:477-489).  Here the topology is a `jax.sharding.Mesh` over the
+axis names ("x", "y", "z") and the "rank extents" are shard_map block shapes.
+
+Uneven grids: shard_map needs equal blocks, so instead of the reference's
+bigger-last-rank scheme (mpi_sol.cpp:417-421) the fundamental (N, N, N)
+domain is zero-padded per axis to `block * mesh_dim` and the pad cells are
+masked out of the update and the error reduction.  The last shard therefore
+owns `r_last <= block` real planes; `r_last` drives the halo-exchange index
+arithmetic in `wavetpu.comm.halo`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+
+AXIS_NAMES = ("x", "y", "z")
+
+
+def choose_mesh_shape(n_devices: int) -> Tuple[int, int, int]:
+    """Near-cubic 3D factorization of `n_devices` (MPI_Dims_create analog).
+
+    Returns (mx, my, mz) with mx >= my >= mz, as balanced as possible
+    (reference relies on MPI_Dims_create the same way, mpi_sol.cpp:407).
+    """
+    best = (n_devices, 1, 1)
+    best_score = n_devices  # max/min spread proxy: the max dim
+    for a in range(1, int(round(n_devices ** (1 / 3))) + 2):
+        if n_devices % a:
+            continue
+        rest = n_devices // a
+        for b in range(a, int(math.isqrt(rest)) + 1):
+            if rest % b:
+                continue
+            c = rest // b
+            dims = tuple(sorted((a, b, c), reverse=True))
+            if dims[0] < best_score:
+                best, best_score = dims, dims[0]
+    return best
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Static decomposition of the fundamental (N, N, N) domain over a mesh.
+
+    block[a]   - shard extent along axis a (equal for every shard)
+    padded[a]  - block[a] * mesh_shape[a] >= N (zero-padded global extent)
+    r_last[a]  - number of *real* (non-pad) planes owned by the last shard
+    """
+
+    N: int
+    mesh_shape: Tuple[int, int, int]
+
+    def __post_init__(self):
+        for m, name in zip(self.mesh_shape, AXIS_NAMES):
+            if m < 1:
+                raise ValueError(f"mesh dim {name} must be >= 1, got {m}")
+            b = -(-self.N // m)  # ceil
+            if self.N - (m - 1) * b < 1:
+                raise ValueError(
+                    f"mesh dim {name}={m} too large for N={self.N}: "
+                    f"last shard would own no real planes"
+                )
+
+    @property
+    def block(self) -> Tuple[int, int, int]:
+        return tuple(-(-self.N // m) for m in self.mesh_shape)
+
+    @property
+    def padded(self) -> Tuple[int, int, int]:
+        return tuple(b * m for b, m in zip(self.block, self.mesh_shape))
+
+    @property
+    def r_last(self) -> Tuple[int, int, int]:
+        return tuple(
+            self.N - (m - 1) * b for b, m in zip(self.block, self.mesh_shape)
+        )
+
+    @property
+    def n_devices(self) -> int:
+        mx, my, mz = self.mesh_shape
+        return mx * my * mz
+
+
+def build_mesh(
+    mesh_shape: Tuple[int, int, int],
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> jax.sharding.Mesh:
+    """3D device mesh with the framework's canonical axis names.
+
+    The ICI counterpart of `MPI_Cart_create` with periods {1,0,0}
+    (mpi_sol.cpp:409-410) - except periodicity lives in the ppermute
+    permutations (comm/halo.py), not in the mesh itself.
+    """
+    if devices is not None:
+        import numpy as np
+
+        arr = np.asarray(devices).reshape(mesh_shape)
+        return jax.sharding.Mesh(arr, AXIS_NAMES)
+    return jax.make_mesh(mesh_shape, AXIS_NAMES)

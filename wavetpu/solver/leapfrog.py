@@ -56,8 +56,7 @@ def _error_fn(problem: Problem, dtype):
     mask = jnp.asarray(oracle.interior_masks_1d(problem.N))
 
     def errors(u, n):
-        ct = ct_table[n]
-        f = oracle.analytic_field(sx, sy, sz, ct)
+        f = oracle.analytic_field(sx, sy, sz, ct_table[n])
         return oracle.layer_errors(u, f, mask, mask, mask)
 
     return errors
@@ -95,11 +94,17 @@ def make_solver(
 
     def run():
         u0, u1 = initial_state(problem, dtype)
+        # Layer 0 is *assigned from* the oracle, so its error is zero by
+        # definition; the reference reads back the memory it just wrote and
+        # reports exactly 0 (openmp_sol.cpp:126-133, 169-190).  Recomputing
+        # the analytic product here and subtracting would measure XLA's FMA
+        # rematerialization noise (~1 ulp), not solver error - u0's
+        # correctness is pinned by tests/test_single_device.py instead.
+        a0 = r0 = jnp.zeros((), dtype)
         if compute_errors:
-            a0, r0 = errors(u0, 0)
             a1, r1 = errors(u1, 1)
         else:
-            a0 = r0 = a1 = r1 = jnp.zeros((), dtype)
+            a1 = r1 = jnp.zeros((), dtype)
 
         def body(carry, n):
             u_prev, u = carry

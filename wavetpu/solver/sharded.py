@@ -1,0 +1,212 @@
+"""Distributed solver: one jitted `shard_map` program over a 3D device mesh.
+
+The analog of the reference's MPI variants (mpi_new.cpp:324-372 fused loop,
+mpi_sol.cpp:374-478 topology setup) redesigned for ICI: the whole solve -
+layer-0/1 bootstrap, the time loop, halo exchange, boundary masking, and the
+cross-device error max-reduction - is a single XLA computation per chip.
+There is no host round-trip anywhere: halos ride `ppermute` (comm/halo.py)
+and the per-layer L-inf errors are `lax.pmax`-reduced in-program (the
+counterpart of the end-of-run MPI_Reduce(MPI_MAX), mpi_new.cpp:360-361).
+
+Sharding model (see core/grid.py): the fundamental (N, N, N) state is
+zero-padded per axis to a multiple of the mesh dim and laid out
+PartitionSpec("x", "y", "z").  All 1-D problem data (analytic factors, error
+masks, boundary masks) is precomputed on host in f64, padded, and sharded
+along its own axis, so every shard receives exactly its slice - the moral
+equivalent of the reference's per-rank x_0/y_0/z_0 offsets
+(mpi_sol.cpp:423-429) without any per-rank branching.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from wavetpu.comm import halo
+from wavetpu.core.grid import AXIS_NAMES, Topology, build_mesh, choose_mesh_shape
+from wavetpu.core.problem import Problem
+from wavetpu.kernels import stencil_ref
+from wavetpu.solver.leapfrog import SolveResult
+from wavetpu.verify import oracle
+
+
+def _padded_factors(problem: Problem, topo: Topology, dtype):
+    """Host-f64 1-D analytic factors on the padded per-axis grids.
+
+    Pad cells get factor 0, so the padded analytic field vanishes there
+    (consistent with the zero-padded state).  Mirrors oracle.spatial_factors.
+    """
+    px, py, pz = topo.padded
+    n = problem.N
+
+    def pad(v, p):
+        out = np.zeros(p, dtype=np.float64)
+        out[:n] = v
+        return out
+
+    i = np.arange(n, dtype=np.float64)
+    sx = pad(np.sin(2.0 * np.pi * (i * problem.hx) / problem.Lx), px)
+    sy = pad(np.sin(np.pi * (i * problem.hy) / problem.Ly), py)
+    sz = pad(np.sin(np.pi * (i * problem.hz) / problem.Lz), pz)
+    return (
+        jnp.asarray(sx, dtype=dtype),
+        jnp.asarray(sy, dtype=dtype),
+        jnp.asarray(sz, dtype=dtype),
+    )
+
+
+def _masks(problem: Problem, topo: Topology, dtype):
+    """1-D boundary multipliers and error-interior masks, padded.
+
+    bc (multiplied into every updated layer):
+      x: 1 for real cells (global i < N) - the x=0 plane is a live periodic
+         cell; 0 for pad cells.
+      y/z: 0 at the stored Dirichlet plane (global 0) and pad cells
+         (reference zeroes its y/z faces each step, openmp_sol.cpp:104-112).
+    err (error reduction, reference interior = global 1..N-1 per axis,
+         openmp_sol.cpp:174-176): global index != 0 and < N.
+    """
+    n = problem.N
+    bc, err = [], []
+    for axis, p in enumerate(topo.padded):
+        g = np.arange(p)
+        real = g < n
+        if axis == 0:
+            bc.append(real.astype(np.float64))
+        else:
+            bc.append((real & (g != 0)).astype(np.float64))
+        err.append(real & (g != 0))
+    bcs = tuple(jnp.asarray(b, dtype=dtype) for b in bc)
+    errs = tuple(jnp.asarray(e) for e in err)
+    return bcs, errs
+
+
+def make_sharded_solver(
+    problem: Problem,
+    topo: Topology,
+    mesh: jax.sharding.Mesh,
+    dtype=jnp.float32,
+    compute_errors: bool = True,
+):
+    """Build the jitted end-to-end sharded solver (no runtime array inputs).
+
+    Returns a zero-arg callable producing (u_prev, u_cur, abs_errs, rel_errs)
+    with u_* sharded P("x","y","z") and the error vectors replicated.
+    """
+    nsteps = problem.timesteps
+    c_full = problem.a2tau2
+    inv_h2 = problem.inv_h2
+
+    sx, sy, sz = _padded_factors(problem, topo, dtype)
+    (bcx, bcy, bcz), (mex, mey, mez) = _masks(problem, topo, dtype)
+    ct_table = oracle.time_factor_table(problem, dtype)
+
+    def local_solve(sx, sy, sz, bcx, bcy, bcz, mex, mey, mez, ct):
+        bc = bcx[:, None, None] * bcy[None, :, None] * bcz[None, None, :]
+
+        def errors(u, n):
+            if not compute_errors:
+                z = jnp.zeros((), dtype)
+                return z, z
+            f = oracle.analytic_field(sx, sy, sz, ct[n])
+            ae, re = oracle.layer_errors(u, f, mex, mey, mez)
+            return (
+                jax.lax.pmax(ae, AXIS_NAMES),
+                jax.lax.pmax(re, AXIS_NAMES),
+            )
+
+        def step(u_prev, u, coeff):
+            ext = halo.halo_extend(u, topo)
+            lap = stencil_ref.laplacian_ext(ext, inv_h2)
+            return u_prev + coeff * lap
+
+        # Layer 0: analytic init (calculate_start, mpi_new.cpp:271-290).
+        u0 = oracle.analytic_field(sx, sy, sz, ct[0]) * bc
+        # Layer 0 is assigned from the oracle, so its error is zero by
+        # definition (see solver/leapfrog.py for the rationale and the XLA
+        # rematerialization-noise trap this avoids).
+        a0 = r0 = jnp.zeros((), dtype)
+        # Layer 1: Taylor half-step u1 = u0 + c/2 lap(u0) (mpi_new.cpp:300-316).
+        u1 = step(u0, u0, jnp.asarray(0.5 * c_full, dtype)) * bc
+        a1, r1 = errors(u1, 1)
+
+        def body(carry, n):
+            u_prev, u = carry
+            # Leapfrog: 2u - u_prev + c lap(u) (mpi_new.cpp:335-347).
+            u_next = step(2.0 * u - u_prev, u, jnp.asarray(c_full, dtype)) * bc
+            ae, re = errors(u_next, n)
+            return (u, u_next), (ae, re)
+
+        (u_prev, u_cur), (abs_t, rel_t) = jax.lax.scan(
+            body, (u0, u1), jnp.arange(2, nsteps + 1)
+        )
+        abs_all = jnp.concatenate([jnp.stack([a0, a1]), abs_t])
+        rel_all = jnp.concatenate([jnp.stack([r0, r1]), rel_t])
+        return u_prev, u_cur, abs_all, rel_all
+
+    sharded = jax.shard_map(
+        local_solve,
+        mesh=mesh,
+        in_specs=(
+            P("x"), P("y"), P("z"),
+            P("x"), P("y"), P("z"),
+            P("x"), P("y"), P("z"),
+            P(),
+        ),
+        out_specs=(P(*AXIS_NAMES), P(*AXIS_NAMES), P(), P()),
+    )
+
+    def run():
+        return sharded(sx, sy, sz, bcx, bcy, bcz, mex, mey, mez, ct_table)
+
+    return jax.jit(run)
+
+
+def solve_sharded(
+    problem: Problem,
+    mesh_shape: Optional[Tuple[int, int, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    dtype=jnp.float32,
+    compute_errors: bool = True,
+) -> SolveResult:
+    """Compile + run the distributed solve; returns the same SolveResult as
+    the single-device path (errors are cross-device maxima).
+
+    `mesh_shape` defaults to a near-cubic factorization of the available
+    device count (MPI_Dims_create analog, mpi_sol.cpp:407).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if mesh_shape is None:
+        mesh_shape = choose_mesh_shape(len(devices))
+    topo = Topology(N=problem.N, mesh_shape=mesh_shape)
+    mesh = build_mesh(mesh_shape, devices[: topo.n_devices])
+
+    t0 = time.perf_counter()
+    runner = make_sharded_solver(problem, topo, mesh, dtype, compute_errors)
+    compiled = runner.lower().compile()
+    t1 = time.perf_counter()
+    u_prev, u_cur, abs_all, rel_all = compiled()
+    jax.block_until_ready((u_prev, u_cur, abs_all, rel_all))
+    t2 = time.perf_counter()
+    return SolveResult(
+        problem=problem,
+        u_prev=u_prev,
+        u_cur=u_cur,
+        abs_errors=np.asarray(abs_all, dtype=np.float64),
+        rel_errors=np.asarray(rel_all, dtype=np.float64),
+        init_seconds=t1 - t0,
+        solve_seconds=t2 - t1,
+    )
+
+
+def gather_fundamental(u: jax.Array, problem: Problem) -> np.ndarray:
+    """Fetch the (possibly padded) sharded field to host and strip padding,
+    returning the (N, N, N) fundamental domain."""
+    n = problem.N
+    return np.asarray(u)[:n, :n, :n]
