@@ -1,0 +1,54 @@
+"""Driver benchmark: one JSON line on stdout, run on the real TPU chip.
+
+Headline config follows BASELINE.md's primary metric: N=512, 1000 steps,
+f32 state, fused analytic-error oracle ON (the reference always self-
+validates, mpi_new.cpp:340-344, so the honest number includes it).
+
+Throughput definition (pinned; ADVICE r1): cell updates per step are
+(N+1)^3 - the reference's grid-point count - times `timesteps` steps,
+divided by solve wall time (excludes compile).  vs_baseline is relative to
+the 6.1 Gcell/s the round-1 judge measured for the jnp-roll path on this
+same single v5e chip; >1.0 means the kernel work is paying off.
+"""
+
+import json
+import sys
+
+BASELINE_GCELLS = 6.1  # r1 judge measurement, single v5e chip, jnp-roll f32
+
+
+def main() -> int:
+    import jax
+
+    from wavetpu.core.problem import Problem
+    from wavetpu.solver import leapfrog
+
+    dev = jax.devices()[0]
+    n = 512
+    steps = 1000
+    problem = Problem(N=n, timesteps=steps)
+    res = leapfrog.solve(problem)  # f32, fused errors
+    line = {
+        "metric": "gcell_updates_per_s",
+        "value": round(res.gcells_per_second, 3),
+        "unit": "Gcell/s",
+        "vs_baseline": round(res.gcells_per_second / BASELINE_GCELLS, 3),
+        "config": {
+            "N": n,
+            "timesteps": steps,
+            "dtype": "float32",
+            "errors_fused": True,
+            "device": str(dev),
+            "backend": "single-chip jnp-roll",
+        },
+        "solve_seconds": round(res.solve_seconds, 3),
+        "compile_seconds": round(res.init_seconds, 3),
+        "max_abs_error": float(res.abs_errors.max()),
+        "baseline_note": "6.1 Gcell/s = round-1 judge measurement, same chip",
+    }
+    print(json.dumps(line))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

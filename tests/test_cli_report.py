@@ -1,0 +1,97 @@
+"""CLI + report-writer gates: the observable output contract.
+
+The reference's contract is positional argv, a Courant printout, and a
+rank-0 report file with fixed line layout (SURVEY.md section 0); these tests
+pin both the text format and the JSON sidecar.
+"""
+
+import json
+import os
+import re
+
+import numpy as np
+import pytest
+
+from wavetpu import cli
+from wavetpu.core.problem import Problem
+from wavetpu.io import report
+from wavetpu.solver import leapfrog
+
+
+def test_report_filename_contract():
+    assert report.report_filename(128, 1) == "output_N128_Np1_TPU.txt"
+    assert (
+        report.report_filename(512, 8, n_threads=4)
+        == "output_N512_Np8_Nt4_TPU.txt"
+    )
+
+
+def test_report_format(tmp_path, small_problem):
+    res = leapfrog.solve(small_problem)
+    path = report.write_report(
+        res,
+        out_dir=str(tmp_path),
+        exchange_seconds=0.5,
+        loop_seconds=1.5,
+    )
+    text = open(path).read()
+    lines = text.splitlines()
+    assert re.fullmatch(r"grids initialized in \d+ms", lines[0])
+    assert re.fullmatch(r"numerical solution calculated in \d+ms", lines[1])
+    # One error line per layer, reference-verbatim prefix.
+    layer_lines = [l for l in lines if l.startswith("max abs and rel errors")]
+    assert len(layer_lines) == small_problem.timesteps + 1
+    assert re.fullmatch(
+        r"max abs and rel errors on layer 3: [-0-9.e+]+ [-0-9.e+]+",
+        layer_lines[3],
+    )
+    assert "total ICI exchange time: 500ms" in lines
+    assert "total loop time: 1500ms" in lines
+
+    side = json.load(open(path.replace(".txt", ".json")))
+    assert side["problem"]["N"] == small_problem.N
+    assert side["max_abs_error"] == pytest.approx(res.abs_errors.max())
+    assert len(side["abs_errors"]) == small_problem.timesteps + 1
+
+
+def test_cli_single_device(tmp_path, capsys):
+    rc = cli.main(
+        [
+            "16", "1", "1", "1", "1", "1", "5",
+            "--backend", "single", "--out-dir", str(tmp_path),
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.startswith("C = ")
+    assert os.path.exists(tmp_path / "output_N16_Np1_TPU.txt")
+
+
+def test_cli_sharded_mesh(tmp_path, capsys):
+    rc = cli.main(
+        [
+            "16", "1", "1", "1", "1", "1", "5",
+            "--mesh", "2,2,2", "--out-dir", str(tmp_path),
+        ]
+    )
+    assert rc == 0
+    assert os.path.exists(tmp_path / "output_N16_Np8_TPU.txt")
+    side = json.load(open(tmp_path / "output_N16_Np8_TPU.json"))
+    assert np.isfinite(side["max_abs_error"])
+
+
+def test_cli_pi_literal_and_defaults(tmp_path, capsys):
+    rc = cli.main(
+        ["16", "2", "pi", "1", "pi", "--backend", "single",
+         "--out-dir", str(tmp_path)]
+    )
+    assert rc == 0
+    side = json.load(open(tmp_path / "output_N16_Np1_TPU.json"))
+    assert side["problem"]["Lx"] == pytest.approx(np.pi)
+    assert side["problem"]["T"] == 1.0
+    assert side["problem"]["timesteps"] == 20
+
+
+def test_cli_bad_args(capsys):
+    assert cli.main(["16"]) == 2
+    assert "usage" in capsys.readouterr().err

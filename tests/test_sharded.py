@@ -60,7 +60,7 @@ def test_sharded_uneven_last_shard_single_plane():
     _parity(p, (1, 4, 1))
 
 
-def test_sharded_pad_cells_stay_zero(small_problem):
+def test_sharded_pad_cells_stay_zero():
     res = sharded.solve_sharded(
         Problem(N=15, timesteps=6), mesh_shape=(2, 2, 2), dtype=jnp.float64
     )

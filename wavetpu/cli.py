@@ -1,0 +1,129 @@
+"""Command-line entry point, argv-compatible with every reference variant.
+
+Positional contract (must never break): `N Np Lx Ly Lz [T] [timesteps]`,
+where Lx/Ly/Lz accept the literal string "pi" and T/timesteps default to
+1 and 20 (openmp_sol.cpp:192-204, mpi_new.cpp:376-404, README.txt:7-8).
+Np is parsed for compatibility; like the reference MPI/CUDA variants it does
+not influence the computation (mpi_sol.cpp:381).
+
+Beyond the positional contract, optional flags select the TPU backend
+pieces (the reference picks variants by compiling different binaries; we
+pick at runtime):
+
+  --backend {auto,single,sharded}   auto = sharded iff >1 device
+  --mesh MX,MY,MZ                   explicit 3D mesh shape (sharded)
+  --dtype {f32,f64,bf16}            state dtype (f64 only meaningful on CPU)
+  --no-errors                       skip the fused analytic-error oracle
+  --out-dir DIR                     where the report file goes
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional, Sequence, Tuple
+
+from wavetpu.core.problem import Problem
+
+
+def _split_flags(argv: Sequence[str]) -> Tuple[List[str], dict]:
+    """Separate reference-style positionals from --flag[=value] options."""
+    pos, flags = [], {}
+    it = iter(argv)
+    for a in it:
+        if a.startswith("--"):
+            if "=" in a:
+                k, v = a[2:].split("=", 1)
+            else:
+                k = a[2:]
+                v = "" if k in ("no-errors",) else next(it)
+            flags[k] = v
+        else:
+            pos.append(a)
+    return pos, flags
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    pos, flags = _split_flags(argv)
+    try:
+        problem = Problem.from_argv(pos)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        print(
+            "usage: wavetpu N Np Lx Ly Lz [T] [timesteps] "
+            "[--backend auto|single|sharded] [--mesh MX,MY,MZ] "
+            "[--dtype f32|f64|bf16] [--no-errors] [--out-dir DIR]",
+            file=sys.stderr,
+        )
+        return 2
+
+    # Courant printout before solving (openmp_sol.cpp:214, mpi_new.cpp:404).
+    print(f"C = {problem.courant:.6g}")
+
+    import jax
+    import jax.numpy as jnp
+
+    dtype = {
+        "f32": jnp.float32,
+        "f64": jnp.float64,
+        "bf16": jnp.bfloat16,
+    }[flags.get("dtype", "f32")]
+    if dtype == jnp.float64:
+        jax.config.update("jax_enable_x64", True)
+    compute_errors = "no-errors" not in flags
+    out_dir = flags.get("out-dir", ".")
+
+    n_devices = len(jax.devices())
+    backend = flags.get("backend", "auto")
+    mesh_shape = None
+    if "mesh" in flags:
+        mesh_shape = tuple(int(x) for x in flags["mesh"].split(","))
+        if len(mesh_shape) != 3:
+            print("error: --mesh wants MX,MY,MZ", file=sys.stderr)
+            return 2
+        backend = "sharded"
+    elif backend == "auto":
+        backend = "sharded" if n_devices > 1 else "single"
+
+    if backend == "sharded":
+        from wavetpu.solver import sharded
+
+        result = sharded.solve_sharded(
+            problem,
+            mesh_shape=mesh_shape,
+            dtype=dtype,
+            compute_errors=compute_errors,
+        )
+        from wavetpu.core.grid import choose_mesh_shape
+
+        shape = mesh_shape or choose_mesh_shape(n_devices)
+        n_procs = shape[0] * shape[1] * shape[2]
+        variant = "TPU"
+    else:
+        from wavetpu.solver import leapfrog
+
+        result = leapfrog.solve(
+            problem, dtype=dtype, compute_errors=compute_errors
+        )
+        n_procs = 1
+        variant = "TPU"
+
+    from wavetpu.io import report
+
+    path = report.write_report(
+        result, out_dir=out_dir, n_procs=n_procs, variant=variant
+    )
+    print(f"grids initialized in {int(result.init_seconds * 1000)}ms")
+    print(
+        f"numerical solution calculated in "
+        f"{int(result.solve_seconds * 1000)}ms"
+    )
+    if compute_errors:
+        print(f"max abs error: {result.abs_errors.max():.6g}")
+    print(f"throughput: {result.gcells_per_second:.3f} Gcell-updates/s")
+    print(f"report: {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,96 @@
+"""Run-report writer, format-compatible with the reference's output files.
+
+The reference's rank 0 writes `output_N{N}_Np{procs}[_..]_{variant}.txt`
+containing init time, solve wall time, per-layer L-inf abs/rel errors, and
+(new/cuda variants) a timing breakdown (openmp_sol.cpp:229, mpi_new.cpp:454,
+lines written at mpi_new.cpp:474,356-371 and cuda_sol.cpp:427-442).  The
+layer-error lines here are verbatim-compatible ("max abs and rel errors on
+layer n: A R") so outputs diff cleanly against reference runs; the timing
+labels name the TPU phases honestly (ICI exchange, not MPI).  A structured
+JSON sidecar carries the same data plus throughput for machines.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+from wavetpu.solver.leapfrog import SolveResult
+
+
+def _fmt(x: float) -> str:
+    """C++ ostream default formatting: 6 significant digits, shortest form."""
+    s = f"{x:.6g}"
+    return s
+
+
+def report_filename(
+    N: int, n_procs: int, variant: str = "TPU", n_threads: Optional[int] = None
+) -> str:
+    """Reference naming convention (SURVEY.md section 0 output contract):
+    output_N{N}_Np{procs}[_Nt{threads}]_{variant}.txt."""
+    parts = [f"output_N{N}", f"Np{n_procs}"]
+    if n_threads is not None:
+        parts.append(f"Nt{n_threads}")
+    return "_".join(parts) + f"_{variant}.txt"
+
+
+def format_report(
+    result: SolveResult,
+    exchange_seconds: Optional[float] = None,
+    loop_seconds: Optional[float] = None,
+) -> str:
+    """Render the text report body (reference line layout)."""
+    lines = [
+        f"grids initialized in {int(result.init_seconds * 1000)}ms",
+        f"numerical solution calculated in {int(result.solve_seconds * 1000)}ms",
+    ]
+    for n, (a, r) in enumerate(zip(result.abs_errors, result.rel_errors)):
+        lines.append(
+            f"max abs and rel errors on layer {n}: {_fmt(a)} {_fmt(r)}"
+        )
+    if exchange_seconds is not None:
+        lines.append(
+            f"total ICI exchange time: {int(exchange_seconds * 1000)}ms"
+        )
+    if loop_seconds is not None:
+        lines.append(f"total loop time: {int(loop_seconds * 1000)}ms")
+    return "\n".join(lines) + "\n"
+
+
+def write_report(
+    result: SolveResult,
+    out_dir: str = ".",
+    n_procs: int = 1,
+    variant: str = "TPU",
+    exchange_seconds: Optional[float] = None,
+    loop_seconds: Optional[float] = None,
+    json_sidecar: bool = True,
+) -> str:
+    """Write the text report (+ JSON sidecar); returns the text-file path."""
+    p = result.problem
+    name = report_filename(p.N, n_procs, variant)
+    path = os.path.join(out_dir, name)
+    with open(path, "w") as f:
+        f.write(format_report(result, exchange_seconds, loop_seconds))
+    if json_sidecar:
+        side = {
+            "problem": dataclasses.asdict(p),
+            "courant": p.courant,
+            "variant": variant,
+            "n_procs": n_procs,
+            "init_seconds": result.init_seconds,
+            "solve_seconds": result.solve_seconds,
+            "gcells_per_second": result.gcells_per_second,
+            "cells_per_step": p.cells_per_step,
+            "max_abs_error": float(result.abs_errors.max()),
+            "abs_errors": [float(x) for x in result.abs_errors],
+            "rel_errors": [float(x) for x in result.rel_errors],
+            "exchange_seconds": exchange_seconds,
+            "loop_seconds": loop_seconds,
+        }
+        with open(path.replace(".txt", ".json"), "w") as f:
+            json.dump(side, f, indent=1)
+    return path

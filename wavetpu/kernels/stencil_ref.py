@@ -15,8 +15,9 @@ cyclic rolls, which is exact because of the state representation documented in
    counterpart of the reference zeroing all four y/z faces each step,
    openmp_sol.cpp:104-112).
 
-The Pallas kernel in `stencil_pallas.py` must agree with this module bitwise
-on identical inputs (tested in tests/test_pallas.py).
+This module is the semantic reference for any fused kernel implementation:
+a Pallas kernel substituted via `make_solver(step_fn=...)` must agree with
+it to rounding error on identical inputs.
 """
 
 from __future__ import annotations

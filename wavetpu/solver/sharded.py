@@ -39,9 +39,9 @@ def _padded_factors(problem: Problem, topo: Topology, dtype):
     """Host-f64 1-D analytic factors on the padded per-axis grids.
 
     Pad cells get factor 0, so the padded analytic field vanishes there
-    (consistent with the zero-padded state).  Mirrors oracle.spatial_factors.
+    (consistent with the zero-padded state).  The factor formulas live in
+    oracle.spatial_factors_np (single source of truth).
     """
-    px, py, pz = topo.padded
     n = problem.N
 
     def pad(v, p):
@@ -49,14 +49,10 @@ def _padded_factors(problem: Problem, topo: Topology, dtype):
         out[:n] = v
         return out
 
-    i = np.arange(n, dtype=np.float64)
-    sx = pad(np.sin(2.0 * np.pi * (i * problem.hx) / problem.Lx), px)
-    sy = pad(np.sin(np.pi * (i * problem.hy) / problem.Ly), py)
-    sz = pad(np.sin(np.pi * (i * problem.hz) / problem.Lz), pz)
-    return (
-        jnp.asarray(sx, dtype=dtype),
-        jnp.asarray(sy, dtype=dtype),
-        jnp.asarray(sz, dtype=dtype),
+    factors = oracle.spatial_factors_np(problem, n)
+    return tuple(
+        jnp.asarray(pad(v, p), dtype=dtype)
+        for v, p in zip(factors, topo.padded)
     )
 
 
@@ -185,6 +181,11 @@ def solve_sharded(
     if mesh_shape is None:
         mesh_shape = choose_mesh_shape(len(devices))
     topo = Topology(N=problem.N, mesh_shape=mesh_shape)
+    if len(devices) < topo.n_devices:
+        raise ValueError(
+            f"mesh {mesh_shape} needs {topo.n_devices} devices, "
+            f"only {len(devices)} available"
+        )
     mesh = build_mesh(mesh_shape, devices[: topo.n_devices])
 
     t0 = time.perf_counter()

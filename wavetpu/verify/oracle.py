@@ -30,20 +30,27 @@ from wavetpu.core.problem import Problem
 TWO_PI = 2.0 * math.pi
 
 
-def spatial_factors(problem: Problem, dtype=jnp.float32):
-    """1-D spatial factors (sx, sy, sz) on the fundamental (N,N,N) grid.
+def spatial_factors_np(problem: Problem, n_points: int):
+    """Host-f64 1-D spatial factors over indices 0..n_points-1 (numpy).
 
     sx[i] = sin(2*pi*(i*hx)/Lx), sy[j] = sin(pi*(j*hy)/Ly),
-    sz[k] = sin(pi*(k*hz)/Lz), for i,j,k in 0..N-1.
+    sz[k] = sin(pi*(k*hz)/Lz).  The single source of truth for the
+    analytic solution's spatial part; every other helper pads/casts this.
+    """
+    i = np.arange(n_points, dtype=np.float64)
+    sx = np.sin(2.0 * np.pi * (i * problem.hx) / problem.Lx)
+    sy = np.sin(np.pi * (i * problem.hy) / problem.Ly)
+    sz = np.sin(np.pi * (i * problem.hz) / problem.Lz)
+    return sx, sy, sz
+
+
+def spatial_factors(problem: Problem, dtype=jnp.float32):
+    """1-D spatial factors (sx, sy, sz) on the fundamental (N,N,N) grid.
 
     Computed in float64 on host and cast once, so low-precision runs still
     compare against a well-rounded oracle.
     """
-    n = problem.N
-    i = np.arange(n, dtype=np.float64)
-    sx = np.sin(2.0 * np.pi * (i * problem.hx) / problem.Lx)
-    sy = np.sin(np.pi * (i * problem.hy) / problem.Ly)
-    sz = np.sin(np.pi * (i * problem.hz) / problem.Lz)
+    sx, sy, sz = spatial_factors_np(problem, problem.N)
     return (
         jnp.asarray(sx, dtype=dtype),
         jnp.asarray(sy, dtype=dtype),
@@ -117,11 +124,7 @@ def full_analytic_grid(problem: Problem, n: int, dtype=np.float64) -> np.ndarray
     Used by tests and the history-mode post-hoc error path (the analog of the
     reference's precomputed `prec_sol` grid, openmp_sol.cpp:85-100).
     """
-    N = problem.N
-    i = np.arange(N + 1, dtype=np.float64)
-    sx = np.sin(2.0 * np.pi * (i * problem.hx) / problem.Lx)
-    sy = np.sin(np.pi * (i * problem.hy) / problem.Ly)
-    sz = np.sin(np.pi * (i * problem.hz) / problem.Lz)
+    sx, sy, sz = spatial_factors_np(problem, problem.N + 1)
     ct = math.cos(problem.a_t * problem.tau * n + TWO_PI)
     return (
         sx[:, None, None] * sy[None, :, None] * sz[None, None, :] * ct
