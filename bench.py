@@ -21,13 +21,28 @@ def main() -> int:
     import jax
 
     from wavetpu.core.problem import Problem
+    from wavetpu.kernels import stencil_pallas
     from wavetpu.solver import leapfrog
 
     dev = jax.devices()[0]
     n = 512
     steps = 1000
     problem = Problem(N=n, timesteps=steps)
-    res = leapfrog.solve(problem)  # f32, fused errors
+    backend = "pallas-fused"
+    try:
+        res = leapfrog.solve(
+            problem, step_fn=stencil_pallas.make_step_fn()
+        )  # f32, fused errors
+    except Exception:
+        # CPU-only environments (no Mosaic): fall back to the XLA path so
+        # the driver always captures a number.  The reason is printed to
+        # stderr so a Pallas regression on real hardware is not silent.
+        import traceback
+
+        print("pallas path failed, falling back to jnp-roll:", file=sys.stderr)
+        traceback.print_exc()
+        backend = "jnp-roll"
+        res = leapfrog.solve(problem)
     line = {
         "metric": "gcell_updates_per_s",
         "value": round(res.gcells_per_second, 3),
@@ -39,7 +54,7 @@ def main() -> int:
             "dtype": "float32",
             "errors_fused": True,
             "device": str(dev),
-            "backend": "single-chip jnp-roll",
+            "backend": f"single-chip {backend}",
         },
         "solve_seconds": round(res.solve_seconds, 3),
         "compile_seconds": round(res.init_seconds, 3),

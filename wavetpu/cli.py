@@ -15,6 +15,9 @@ pick at runtime):
   --dtype {f32,f64,bf16}            state dtype (f64 only meaningful on CPU)
   --no-errors                       skip the fused analytic-error oracle
   --out-dir DIR                     where the report file goes
+  --platform NAME                   jax platform (e.g. cpu); also honors the
+                                    JAX_PLATFORMS env var, which this image's
+                                    sitecustomize would otherwise override
 """
 
 from __future__ import annotations
@@ -25,8 +28,16 @@ from typing import List, Optional, Sequence, Tuple
 from wavetpu.core.problem import Problem
 
 
+_KNOWN_FLAGS = ("backend", "mesh", "dtype", "no-errors", "out-dir", "platform")
+_VALUELESS = ("no-errors",)
+
+
 def _split_flags(argv: Sequence[str]) -> Tuple[List[str], dict]:
-    """Separate reference-style positionals from --flag[=value] options."""
+    """Separate reference-style positionals from --flag[=value] options.
+
+    Raises ValueError for unknown flags or a flag missing its value, so typos
+    surface as the usage error instead of being silently ignored.
+    """
     pos, flags = [], {}
     it = iter(argv)
     for a in it:
@@ -35,7 +46,14 @@ def _split_flags(argv: Sequence[str]) -> Tuple[List[str], dict]:
                 k, v = a[2:].split("=", 1)
             else:
                 k = a[2:]
-                v = "" if k in ("no-errors",) else next(it)
+                if k in _VALUELESS:
+                    v = ""
+                else:
+                    v = next(it, None)
+                    if v is None:
+                        raise ValueError(f"flag --{k} needs a value")
+            if k not in _KNOWN_FLAGS:
+                raise ValueError(f"unknown flag --{k}")
             flags[k] = v
         else:
             pos.append(a)
@@ -44,15 +62,20 @@ def _split_flags(argv: Sequence[str]) -> Tuple[List[str], dict]:
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
-    pos, flags = _split_flags(argv)
     try:
+        pos, flags = _split_flags(argv)
+        if flags.get("dtype", "f32") not in ("f32", "f64", "bf16"):
+            raise ValueError(f"--dtype must be f32|f64|bf16, got {flags['dtype']}")
+        if flags.get("backend") == "single" and "mesh" in flags:
+            raise ValueError("--mesh contradicts --backend single")
         problem = Problem.from_argv(pos)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         print(
             "usage: wavetpu N Np Lx Ly Lz [T] [timesteps] "
             "[--backend auto|single|sharded] [--mesh MX,MY,MZ] "
-            "[--dtype f32|f64|bf16] [--no-errors] [--out-dir DIR]",
+            "[--dtype f32|f64|bf16] [--no-errors] [--out-dir DIR] "
+            "[--platform NAME]",
             file=sys.stderr,
         )
         return 2
@@ -60,8 +83,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # Courant printout before solving (openmp_sol.cpp:214, mpi_new.cpp:404).
     print(f"C = {problem.courant:.6g}")
 
+    import os
+
     import jax
     import jax.numpy as jnp
+
+    # Honor --platform / the caller's JAX_PLATFORMS. This image pre-imports
+    # jax via a sitecustomize hook that sets jax_platforms itself; backend
+    # init is lazy, so re-applying the user's choice here (before any device
+    # is touched) restores the documented `JAX_PLATFORMS=cpu wavetpu ...`
+    # behavior (same trick as tests/conftest.py).
+    platform = flags.get("platform") or os.environ.get("JAX_PLATFORMS")
+    if platform and platform != jax.config.jax_platforms:
+        jax.config.update("jax_platforms", platform)
 
     dtype = {
         "f32": jnp.float32,
@@ -111,7 +145,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     from wavetpu.io import report
 
     path = report.write_report(
-        result, out_dir=out_dir, n_procs=n_procs, variant=variant
+        result,
+        out_dir=out_dir,
+        n_procs=n_procs,
+        variant=variant,
+        errors_computed=compute_errors,
     )
     print(f"grids initialized in {int(result.init_seconds * 1000)}ms")
     print(

@@ -41,16 +41,25 @@ def format_report(
     result: SolveResult,
     exchange_seconds: Optional[float] = None,
     loop_seconds: Optional[float] = None,
+    errors_computed: bool = True,
 ) -> str:
-    """Render the text report body (reference line layout)."""
+    """Render the text report body (reference line layout).
+
+    With `errors_computed=False` (a --no-errors run) the layer lines are
+    replaced by an explicit marker rather than emitting all-zero errors that
+    would read as a perfect run.
+    """
     lines = [
         f"grids initialized in {int(result.init_seconds * 1000)}ms",
         f"numerical solution calculated in {int(result.solve_seconds * 1000)}ms",
     ]
-    for n, (a, r) in enumerate(zip(result.abs_errors, result.rel_errors)):
-        lines.append(
-            f"max abs and rel errors on layer {n}: {_fmt(a)} {_fmt(r)}"
-        )
+    if errors_computed:
+        for n, (a, r) in enumerate(zip(result.abs_errors, result.rel_errors)):
+            lines.append(
+                f"max abs and rel errors on layer {n}: {_fmt(a)} {_fmt(r)}"
+            )
+    else:
+        lines.append("errors not computed (run without --no-errors to verify)")
     if exchange_seconds is not None:
         lines.append(
             f"total ICI exchange time: {int(exchange_seconds * 1000)}ms"
@@ -68,13 +77,19 @@ def write_report(
     exchange_seconds: Optional[float] = None,
     loop_seconds: Optional[float] = None,
     json_sidecar: bool = True,
+    errors_computed: bool = True,
 ) -> str:
     """Write the text report (+ JSON sidecar); returns the text-file path."""
     p = result.problem
     name = report_filename(p.N, n_procs, variant)
+    os.makedirs(out_dir, exist_ok=True)
     path = os.path.join(out_dir, name)
     with open(path, "w") as f:
-        f.write(format_report(result, exchange_seconds, loop_seconds))
+        f.write(
+            format_report(
+                result, exchange_seconds, loop_seconds, errors_computed
+            )
+        )
     if json_sidecar:
         side = {
             "problem": dataclasses.asdict(p),
@@ -85,12 +100,21 @@ def write_report(
             "solve_seconds": result.solve_seconds,
             "gcells_per_second": result.gcells_per_second,
             "cells_per_step": p.cells_per_step,
-            "max_abs_error": float(result.abs_errors.max()),
-            "abs_errors": [float(x) for x in result.abs_errors],
-            "rel_errors": [float(x) for x in result.rel_errors],
+            "errors_computed": errors_computed,
+            "max_abs_error": (
+                float(result.abs_errors.max()) if errors_computed else None
+            ),
+            "abs_errors": (
+                [float(x) for x in result.abs_errors] if errors_computed else None
+            ),
+            "rel_errors": (
+                [float(x) for x in result.rel_errors] if errors_computed else None
+            ),
             "exchange_seconds": exchange_seconds,
             "loop_seconds": loop_seconds,
         }
-        with open(path.replace(".txt", ".json"), "w") as f:
+        # Derive the sidecar from `name` (not `path`): out_dir may itself
+        # contain ".txt".
+        with open(os.path.join(out_dir, name[:-4] + ".json"), "w") as f:
             json.dump(side, f, indent=1)
     return path

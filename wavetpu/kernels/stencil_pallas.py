@@ -1,0 +1,164 @@
+"""Pallas fused leapfrog stencil kernel - the TPU-native hot kernel.
+
+The analog of the reference's CUDA kernel layer (`calculate_layer`,
+cuda_sol_kernels.cu:24-47, and the BC/seam handling of `prepare_layer`,
+cuda_sol_kernels.cu:230-259) redesigned for the TPU memory system instead of
+translated:
+
+ * The grid marches over slabs of `block_x` x-planes.  Each program reads its
+   slab of u / u_prev plus exactly TWO single-plane x-halos fetched through
+   wrap-around BlockSpec index maps ((i*bx - 1) mod N) - the periodic-x
+   topology costs nothing and there is no seam special case (the fundamental
+   (N, N, N) domain of `wavetpu.core.problem` has no duplicated plane).
+ * y/z neighbours come from in-VMEM cyclic rolls (`pltpu.roll`): the y/z
+   wrap delivers the stored zero Dirichlet plane, so one uniform data path
+   covers interior + all boundaries, where the reference needs a separate
+   boundary kernel with a face bitmask (and shipped a precedence bug in it,
+   SURVEY.md section 2.4.1).
+ * The Dirichlet re-zeroing of the y=0 / z=0 stored planes is fused as a
+   mask on the result - no second kernel, no extra memory pass.
+ * The update 2u - u_prev + c*lap and the boundary mask execute in f32 on
+   the VPU regardless of the storage dtype, so a bf16 state (BASELINE.md
+   stretch config) keeps an f32 update path.
+
+Layout: z is the lane dimension (128), y the sublane dimension (8); an
+(N, N) plane of f32 is tile-aligned for any N multiple of 128.  `block_x`
+is chosen so the pipeline's working set fits comfortably in VMEM
+(~16 MB/core).
+
+Semantics are pinned to `stencil_ref.leapfrog_step` / `taylor_half_step`
+(tested in tests/test_pallas.py, interpret mode on CPU plus allclose on
+chip): identical inputs must agree to rounding error.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from wavetpu.core.problem import Problem
+
+# Per-core VMEM working-set budget (bytes) used to pick block_x: the
+# pipeline double-buffers (3*bx + 2) planes (u slab + u_prev slab + out slab
+# + 2 halo planes), and the kernel body needs room again for temporaries
+# (ext/lap).  The Mosaic scoped-vmem ceiling is raised to _VMEM_LIMIT
+# accordingly (the default 16 MB rejects even a one-plane slab at N=512,
+# and the overflow is not graceful: it NaN'd inside lax.scan in testing).
+# bx=8 at N=512 measured fastest on v5e (20.3 Gcell/s vs 14.6 at bx=1).
+_VMEM_BUDGET = 56 * 1024 * 1024
+_VMEM_LIMIT = 100 * 1024 * 1024
+
+
+def choose_block_x(n: int, itemsize: int = 4) -> int:
+    """Largest power-of-two slab depth (<= 8) whose double-buffered pipeline
+    working set fits the VMEM budget (and divides N)."""
+    plane = n * n * itemsize
+    bx = 1
+    while (
+        bx < 8
+        and n % (bx * 2) == 0
+        and 2 * (3 * (bx * 2) + 2) * plane <= _VMEM_BUDGET
+    ):
+        bx *= 2
+    return bx
+
+
+def _step_kernel(uprev_ref, uc_ref, ulo_ref, uhi_ref, out_ref,
+                 *, alpha, beta, coeff, inv_h2, compute_dtype):
+    """One fused update slab: out = alpha*u - beta*u_prev + coeff*lap(u).
+
+    (alpha, beta, coeff) = (2, 1, a2tau2)  -> leapfrog (openmp_sol.cpp:160)
+    (alpha, beta, coeff) = (1, 0, a2tau2/2) -> layer-1 Taylor half-step
+                                               (openmp_sol.cpp:137-144)
+    """
+    f = compute_dtype
+    c = uc_ref[:].astype(f)
+    ix, iy, iz = (jnp.asarray(v, f) for v in inv_h2)
+    # x-neighbours: halo planes stacked onto the slab (axis 0 is neither
+    # lane nor sublane, so this is free of relayouts).
+    ext = jnp.concatenate([ulo_ref[:].astype(f), c, uhi_ref[:].astype(f)], 0)
+    lap = (ext[:-2] + ext[2:] - 2.0 * c) * ix
+    # y/z neighbours: cyclic rolls ARE the boundary condition (the wrap
+    # delivers the stored zero Dirichlet plane / the periodic value).
+    # pltpu.roll wants non-negative shifts: roll by size-1 == roll by -1.
+    ny, nz = c.shape[1], c.shape[2]
+    lap = lap + (pltpu.roll(c, 1, 1) + pltpu.roll(c, ny - 1, 1) - 2.0 * c) * iy
+    lap = lap + (pltpu.roll(c, 1, 2) + pltpu.roll(c, nz - 1, 2) - 2.0 * c) * iz
+    u_next = jnp.asarray(alpha, f) * c + jnp.asarray(coeff, f) * lap
+    if beta:
+        u_next = u_next - jnp.asarray(beta, f) * uprev_ref[:].astype(f)
+    # Fused Dirichlet: zero the stored y=0 / z=0 planes (the reference's
+    # whole `prepare_layer` pass, openmp_sol.cpp:104-112).
+    shape = u_next.shape
+    ym = lax.broadcasted_iota(jnp.int32, shape, 1) != 0
+    zm = lax.broadcasted_iota(jnp.int32, shape, 2) != 0
+    u_next = jnp.where(ym & zm, u_next, jnp.asarray(0.0, f))
+    out_ref[:] = u_next.astype(out_ref.dtype)
+
+
+def _fused_step(u_prev, u, *, alpha, beta, coeff, inv_h2,
+                block_x=None, interpret=False,
+                compute_dtype=jnp.float32):
+    n = u.shape[0]
+    bx = block_x or choose_block_x(n, u.dtype.itemsize)
+    if n % bx:
+        raise ValueError(f"block_x={bx} must divide N={n}")
+    slab = pl.BlockSpec((bx, n, n), lambda i: (i, 0, 0),
+                        memory_space=pltpu.VMEM)
+    # Single-plane halos via wrap-around maps: with block shape (1, N, N)
+    # the x block index IS the plane index, so these express the cyclic
+    # neighbour relation directly (jnp mod is floor-mod: (0-1) % N = N-1).
+    lo = pl.BlockSpec((1, n, n), lambda i: ((i * bx - 1) % n, 0, 0),
+                      memory_space=pltpu.VMEM)
+    hi = pl.BlockSpec((1, n, n), lambda i: (((i + 1) * bx) % n, 0, 0),
+                      memory_space=pltpu.VMEM)
+    kernel = functools.partial(
+        _step_kernel, alpha=alpha, beta=beta, coeff=coeff,
+        inv_h2=inv_h2, compute_dtype=compute_dtype,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(n // bx,),
+        in_specs=[slab, slab, lo, hi],
+        out_specs=slab,
+        out_shape=jax.ShapeDtypeStruct(u.shape, u.dtype),
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        interpret=interpret,
+    )(u_prev, u, u, u)
+
+
+def leapfrog_step(u_prev, u, problem: Problem, *,
+                  block_x=None, interpret=False):
+    """Fused u_next = 2u - u_prev + a2tau2*lap(u) with Dirichlet re-imposed.
+
+    Drop-in for `stencil_ref.leapfrog_step` (`make_solver(step_fn=...)`).
+    """
+    return _fused_step(
+        u_prev, u, alpha=2.0, beta=1.0, coeff=problem.a2tau2,
+        inv_h2=problem.inv_h2, block_x=block_x, interpret=interpret,
+    )
+
+
+def taylor_half_step(u0, problem: Problem, *, block_x=None, interpret=False):
+    """Fused layer-1 bootstrap u1 = u0 + (a2tau2/2)*lap(u0).
+
+    Drop-in for `stencil_ref.taylor_half_step`.
+    """
+    return _fused_step(
+        u0, u0, alpha=1.0, beta=0.0, coeff=0.5 * problem.a2tau2,
+        inv_h2=problem.inv_h2, block_x=block_x, interpret=interpret,
+    )
+
+
+def make_step_fn(block_x=None, interpret=False):
+    """A `(u_prev, u, problem) -> u_next` closure for `make_solver(step_fn=)`
+    with the kernel tuning parameters bound."""
+    def step(u_prev, u, problem):
+        return leapfrog_step(u_prev, u, problem,
+                             block_x=block_x, interpret=interpret)
+    return step
