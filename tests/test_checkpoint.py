@@ -1,0 +1,54 @@
+"""Checkpoint/resume: kill-and-resume reproduces the uninterrupted run bitwise."""
+
+import numpy as np
+
+from wavetpu.io import checkpoint
+from wavetpu.solver import leapfrog
+
+
+def test_resume_bitwise_equal(small_problem, tmp_path):
+    full = leapfrog.solve(small_problem)
+
+    half = leapfrog.solve(small_problem, stop_step=5)
+    path = checkpoint.save_checkpoint(str(tmp_path / "ck.npz"), half)
+    resumed = checkpoint.resume_solve(path)
+
+    # Bitwise: identical op sequence -> identical floats, not just allclose.
+    np.testing.assert_array_equal(
+        np.asarray(resumed.u_cur), np.asarray(full.u_cur)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(resumed.u_prev), np.asarray(full.u_prev)
+    )
+    # Per-layer errors for the resumed tail match the uninterrupted run's.
+    np.testing.assert_array_equal(resumed.abs_errors[6:], full.abs_errors[6:])
+    assert np.all(resumed.abs_errors[:6] == 0.0)
+    assert resumed.steps_computed == small_problem.timesteps - 5
+
+
+def test_checkpoint_roundtrip(small_problem, tmp_path):
+    half = leapfrog.solve(small_problem, stop_step=3)
+    path = checkpoint.save_checkpoint(str(tmp_path / "state"), half)
+    assert path.endswith(".npz")
+    problem, u_prev, u_cur, step = checkpoint.load_checkpoint(path)
+    assert problem == small_problem
+    assert step == 3
+    np.testing.assert_array_equal(u_cur, np.asarray(half.u_cur))
+    np.testing.assert_array_equal(u_prev, np.asarray(half.u_prev))
+
+
+def test_resume_from_final_state_is_noop(small_problem, tmp_path):
+    full = leapfrog.solve(small_problem)
+    path = checkpoint.save_checkpoint(str(tmp_path / "ck.npz"), full)
+    resumed = checkpoint.resume_solve(path)
+    np.testing.assert_array_equal(
+        np.asarray(resumed.u_cur), np.asarray(full.u_cur)
+    )
+    assert resumed.steps_computed == 0
+
+
+def test_stop_step_is_prefix(small_problem):
+    """A stopped run is the exact prefix of the full run (same tau)."""
+    full = leapfrog.solve(small_problem)
+    half = leapfrog.solve(small_problem, stop_step=5)
+    np.testing.assert_array_equal(half.abs_errors, full.abs_errors[:6])

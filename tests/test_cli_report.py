@@ -95,3 +95,37 @@ def test_cli_pi_literal_and_defaults(tmp_path, capsys):
 def test_cli_bad_args(capsys):
     assert cli.main(["16"]) == 2
     assert "usage" in capsys.readouterr().err
+
+
+def test_cli_bad_flags(capsys):
+    base = ["16", "1", "1", "1", "1", "1", "5"]
+    assert cli.main(base + ["--out_dir", "/tmp"]) == 2       # typo'd flag
+    assert cli.main(base + ["--backend"]) == 2               # missing value
+    assert cli.main(base + ["--dtype", "f16"]) == 2          # bad dtype
+    assert cli.main(
+        base + ["--backend", "single", "--mesh", "2,2,2"]
+    ) == 2                                                   # contradiction
+    capsys.readouterr()
+
+
+def test_cli_preemption_workflow(tmp_path, capsys):
+    """stop-step + save-state then resume == uninterrupted run (bitwise on
+    the report's error tail)."""
+    base = ["16", "1", "1", "1", "1", "1", "10", "--backend", "single"]
+    full_dir, part_dir, res_dir = (
+        str(tmp_path / d) for d in ("full", "part", "res")
+    )
+    ck = str(tmp_path / "ck.npz")
+    assert cli.main(base + ["--out-dir", full_dir]) == 0
+    assert (
+        cli.main(
+            base
+            + ["--out-dir", part_dir, "--stop-step", "6", "--save-state", ck]
+        )
+        == 0
+    )
+    assert cli.main(["--resume", ck, "--out-dir", res_dir]) == 0
+    capsys.readouterr()
+    full = json.load(open(os.path.join(full_dir, "output_N16_Np1_TPU.json")))
+    res = json.load(open(os.path.join(res_dir, "output_N16_Np1_TPU.json")))
+    assert res["abs_errors"][7:] == full["abs_errors"][7:]

@@ -1,0 +1,21 @@
+"""Phase-timing probes (solver/timing.py) on the 8-virtual-device CPU mesh."""
+
+from wavetpu.solver import timing
+
+
+def test_phase_breakdown_sharded(small_problem):
+    pb = timing.measure_phase_breakdown(
+        small_problem, mesh_shape=(2, 2, 2), iters=4, repeats=2
+    )
+    assert pb.loop_seconds > 0.0
+    assert pb.exchange_seconds >= 0.0
+    assert pb.steps_measured == 4
+    assert pb.total_seconds == pb.loop_seconds + pb.exchange_seconds
+
+
+def test_phase_breakdown_single_device(small_problem):
+    pb = timing.measure_phase_breakdown(
+        small_problem, mesh_shape=(1, 1, 1), iters=4, repeats=2
+    )
+    assert pb.loop_seconds > 0.0
+    assert pb.exchange_seconds >= 0.0

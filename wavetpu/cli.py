@@ -18,6 +18,17 @@ pick at runtime):
   --platform NAME                   jax platform (e.g. cpu); also honors the
                                     JAX_PLATFORMS env var, which this image's
                                     sitecustomize would otherwise override
+  --phase-timing                    measure the loop vs ICI-exchange split
+                                    (probe programs; see solver/timing.py) and
+                                    add it to the report, like the reference's
+                                    "new" variants (mpi_new.cpp:368-371)
+  --stop-step S                     halt after layer S (tau unchanged); pairs
+                                    with --save-state for preemptible runs
+  --save-state PATH                 write the final (u_prev, u_cur, step)
+                                    checkpoint (io/checkpoint.py)
+  --resume PATH                     continue a checkpointed run to its
+                                    timesteps (positionals then unnecessary);
+                                    single-device backend only
 """
 
 from __future__ import annotations
@@ -28,8 +39,11 @@ from typing import List, Optional, Sequence, Tuple
 from wavetpu.core.problem import Problem
 
 
-_KNOWN_FLAGS = ("backend", "mesh", "dtype", "no-errors", "out-dir", "platform")
-_VALUELESS = ("no-errors",)
+_KNOWN_FLAGS = (
+    "backend", "mesh", "dtype", "no-errors", "out-dir", "platform",
+    "phase-timing", "stop-step", "save-state", "resume",
+)
+_VALUELESS = ("no-errors", "phase-timing")
 
 
 def _split_flags(argv: Sequence[str]) -> Tuple[List[str], dict]:
@@ -68,7 +82,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             raise ValueError(f"--dtype must be f32|f64|bf16, got {flags['dtype']}")
         if flags.get("backend") == "single" and "mesh" in flags:
             raise ValueError("--mesh contradicts --backend single")
-        problem = Problem.from_argv(pos)
+        if "resume" in flags:
+            if flags.get("backend") == "sharded" or "mesh" in flags:
+                raise ValueError("--resume supports the single backend only")
+            if "stop-step" in flags:
+                raise ValueError("--resume and --stop-step are exclusive")
+            problem = None  # comes from the checkpoint
+        else:
+            problem = Problem.from_argv(pos)
+        stop_step = int(flags["stop-step"]) if "stop-step" in flags else None
+        if stop_step is not None and not (
+            1 <= stop_step <= problem.timesteps
+        ):
+            raise ValueError(
+                f"--stop-step must be in [1, {problem.timesteps}], "
+                f"got {stop_step}"
+            )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         print(
@@ -79,6 +108,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             file=sys.stderr,
         )
         return 2
+
+    resume_state = None
+    if "resume" in flags:
+        from wavetpu.io import checkpoint as _ckpt
+
+        try:
+            problem, _u_prev0, _u_cur0, _start = _ckpt.load_checkpoint(
+                flags["resume"]
+            )
+        except Exception as e:
+            # OSError, KeyError, ValueError, zipfile.BadZipFile (truncated
+            # .npz from a mid-save preemption - the exact case --resume
+            # exists for), ... all mean the same thing to the user.
+            print(f"error: cannot load checkpoint: {e}", file=sys.stderr)
+            return 2
+        resume_state = (_u_prev0, _u_cur0, _start)
 
     # Courant printout before solving (openmp_sol.cpp:214, mpi_new.cpp:404).
     print(f"C = {problem.courant:.6g}")
@@ -116,8 +161,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print("error: --mesh wants MX,MY,MZ", file=sys.stderr)
             return 2
         backend = "sharded"
+    elif resume_state is not None:
+        backend = "single"
     elif backend == "auto":
         backend = "sharded" if n_devices > 1 else "single"
+    if backend == "sharded" and (
+        "save-state" in flags or "stop-step" in flags
+    ):
+        # Checked after backend resolution so `--backend auto` on a
+        # multi-device host cannot silently run a full sharded solve where
+        # a partial single-device one was requested.
+        print(
+            "error: --save-state/--stop-step support the single backend only",
+            file=sys.stderr,
+        )
+        return 2
 
     if backend == "sharded":
         from wavetpu.solver import sharded
@@ -136,11 +194,48 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     else:
         from wavetpu.solver import leapfrog
 
-        result = leapfrog.solve(
-            problem, dtype=dtype, compute_errors=compute_errors
-        )
+        if resume_state is not None:
+            u_prev0, u_cur0, start = resume_state
+            # Unless --dtype was given explicitly, resume in the dtype the
+            # checkpoint was saved with - casting would break the
+            # bitwise-equal-resume guarantee (io/checkpoint.py).
+            resume_dtype = (
+                dtype if "dtype" in flags else jnp.dtype(u_cur0.dtype)
+            )
+            result = leapfrog.resume(
+                problem,
+                u_prev0,
+                u_cur0,
+                start_step=start,
+                dtype=resume_dtype,
+                compute_errors=compute_errors,
+            )
+        else:
+            result = leapfrog.solve(
+                problem,
+                dtype=dtype,
+                compute_errors=compute_errors,
+                stop_step=stop_step,
+            )
         n_procs = 1
         variant = "TPU"
+
+    if "save-state" in flags:
+        from wavetpu.io import checkpoint as _ckpt
+
+        ck_path = _ckpt.save_checkpoint(flags["save-state"], result)
+        print(f"checkpoint: {ck_path}")
+
+    exchange_seconds = loop_seconds = None
+    if "phase-timing" in flags:
+        from wavetpu.solver import timing
+
+        pb = timing.measure_phase_breakdown(
+            problem,
+            mesh_shape=mesh_shape if backend == "sharded" else (1, 1, 1),
+            dtype=dtype,
+        )
+        exchange_seconds, loop_seconds = pb.exchange_seconds, pb.loop_seconds
 
     from wavetpu.io import report
 
@@ -150,12 +245,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         n_procs=n_procs,
         variant=variant,
         errors_computed=compute_errors,
+        exchange_seconds=exchange_seconds,
+        loop_seconds=loop_seconds,
     )
     print(f"grids initialized in {int(result.init_seconds * 1000)}ms")
     print(
         f"numerical solution calculated in "
         f"{int(result.solve_seconds * 1000)}ms"
     )
+    if exchange_seconds is not None:
+        print(f"total ICI exchange time: {int(exchange_seconds * 1000)}ms")
+        print(f"total loop time: {int(loop_seconds * 1000)}ms")
     if compute_errors:
         print(f"max abs error: {result.abs_errors.max():.6g}")
     print(f"throughput: {result.gcells_per_second:.3f} Gcell-updates/s")

@@ -42,10 +42,17 @@ class SolveResult:
     rel_errors: np.ndarray     # per-layer L-inf rel error, shape (timesteps+1,)
     init_seconds: float = 0.0
     solve_seconds: float = 0.0
+    steps_computed: Optional[int] = None  # steps THIS run marched (throughput)
+    final_step: Optional[int] = None      # layer index u_cur holds (checkpoint)
 
     @property
     def gcells_per_second(self) -> float:
-        total = self.problem.cells_per_step * self.problem.timesteps
+        steps = (
+            self.steps_computed
+            if self.steps_computed is not None
+            else self.problem.timesteps
+        )
+        total = self.problem.cells_per_step * steps
         return total / self.solve_seconds / 1e9 if self.solve_seconds else 0.0
 
 
@@ -77,20 +84,73 @@ def initial_state(problem: Problem, dtype=jnp.float32) -> Tuple[jax.Array, jax.A
     return u0, u1
 
 
+def _scan_layers(
+    problem: Problem,
+    step: Callable,
+    errors: Callable,
+    compute_errors: bool,
+    dtype,
+    u_prev,
+    u_cur,
+    start: int,
+    stop: int,
+):
+    """March layers start+1..stop from carry (layer start-1, layer start).
+
+    The single scan body shared by `make_solver` and `resume` - keeping it
+    shared is what makes a resumed run's op sequence identical to the
+    uninterrupted run's (the bitwise-equality invariant of
+    tests/test_checkpoint.py).
+    """
+
+    def body(carry, n):
+        u_prev, u = carry
+        u_next = step(u_prev, u, problem)
+        if compute_errors:
+            ae, re = errors(u_next, n)
+        else:
+            ae = re = jnp.zeros((), dtype)
+        return (u, u_next), (ae, re)
+
+    return jax.lax.scan(body, (u_prev, u_cur), jnp.arange(start + 1, stop + 1))
+
+
+def _timed_compile_run(runner, example_args=()):
+    """lower/compile then execute; returns (outputs, init_s, solve_s) with
+    the reference's two timing phases (mpi_new.cpp:472-474, 354-357)."""
+    t0 = time.perf_counter()
+    lowered = runner.lower(*example_args).compile()
+    t1 = time.perf_counter()
+    out = lowered(*example_args)
+    jax.block_until_ready(out)
+    t2 = time.perf_counter()
+    return out, t1 - t0, t2 - t1
+
+
 def make_solver(
     problem: Problem,
     dtype=jnp.float32,
     step_fn: Optional[Callable] = None,
     compute_errors: bool = True,
+    stop_step: Optional[int] = None,
 ) -> Callable[[], Tuple[jax.Array, jax.Array, jax.Array, jax.Array]]:
     """Build the jitted end-to-end solver (no runtime array inputs).
 
     `step_fn(u_prev, u, problem) -> u_next` defaults to the jnp-roll stencil;
     the Pallas kernel slots in via the same signature.
+
+    `stop_step` halts the march after that layer (default: run to
+    `problem.timesteps`).  tau stays `T / timesteps` regardless, so a stopped
+    run is the exact prefix of the full one - the state a checkpoint captures
+    (io/checkpoint.py) and `resume` continues from.
     """
     step = step_fn or stencil_ref.leapfrog_step
     errors = _error_fn(problem, dtype)
-    nsteps = problem.timesteps
+    nsteps = problem.timesteps if stop_step is None else stop_step
+    if not 1 <= nsteps <= problem.timesteps:
+        raise ValueError(
+            f"stop_step must be in [1, {problem.timesteps}], got {nsteps}"
+        )
 
     def run():
         u0, u1 = initial_state(problem, dtype)
@@ -106,17 +166,8 @@ def make_solver(
         else:
             a1 = r1 = jnp.zeros((), dtype)
 
-        def body(carry, n):
-            u_prev, u = carry
-            u_next = step(u_prev, u, problem)
-            if compute_errors:
-                ae, re = errors(u_next, n)
-            else:
-                ae = re = jnp.zeros((), dtype)
-            return (u, u_next), (ae, re)
-
-        (u_prev, u_cur), (abs_t, rel_t) = jax.lax.scan(
-            body, (u0, u1), jnp.arange(2, nsteps + 1)
+        (u_prev, u_cur), (abs_t, rel_t) = _scan_layers(
+            problem, step, errors, compute_errors, dtype, u0, u1, 1, nsteps
         )
         abs_all = jnp.concatenate([jnp.stack([a0, a1]), abs_t])
         rel_all = jnp.concatenate([jnp.stack([r0, r1]), rel_t])
@@ -130,6 +181,7 @@ def solve(
     dtype=jnp.float32,
     step_fn: Optional[Callable] = None,
     compute_errors: bool = True,
+    stop_step: Optional[int] = None,
 ) -> SolveResult:
     """Compile + run, with the reference's two timing phases.
 
@@ -137,21 +189,78 @@ def solve(
     part of the program); "numerical solution calculated in Xms" is the
     execution wall time (mpi_new.cpp:472-474, 354-357).
     """
-    t0 = time.perf_counter()
-    runner = make_solver(problem, dtype, step_fn, compute_errors)
-    lowered = runner.lower().compile()
-    t1 = time.perf_counter()
-    u_prev, u_cur, abs_all, rel_all = lowered()
-    jax.block_until_ready((u_prev, u_cur, abs_all, rel_all))
-    t2 = time.perf_counter()
+    runner = make_solver(problem, dtype, step_fn, compute_errors, stop_step)
+    (u_prev, u_cur, abs_all, rel_all), init_s, solve_s = _timed_compile_run(
+        runner
+    )
     return SolveResult(
         problem=problem,
         u_prev=u_prev,
         u_cur=u_cur,
         abs_errors=np.asarray(abs_all, dtype=np.float64),
         rel_errors=np.asarray(rel_all, dtype=np.float64),
-        init_seconds=t1 - t0,
-        solve_seconds=t2 - t1,
+        init_seconds=init_s,
+        solve_seconds=solve_s,
+        steps_computed=stop_step,
+        final_step=stop_step if stop_step is not None else problem.timesteps,
+    )
+
+
+def resume(
+    problem: Problem,
+    u_prev,
+    u_cur,
+    start_step: int,
+    dtype=jnp.float32,
+    step_fn: Optional[Callable] = None,
+    compute_errors: bool = True,
+) -> SolveResult:
+    """Re-enter the time loop at layer `start_step` and march to the end.
+
+    `u_prev` / `u_cur` are layers start_step-1 / start_step (what
+    `solve(stop_step=start_step)` returned and io/checkpoint.py stored).
+    Because the per-step operation sequence is identical to an uninterrupted
+    run's, the final state is bitwise-equal to it (pinned by
+    tests/test_checkpoint.py).
+
+    The returned error arrays cover layers start_step+1..timesteps; earlier
+    entries are zero (they belong to the pre-checkpoint run's report).
+    """
+    step = step_fn or stencil_ref.leapfrog_step
+    nsteps = problem.timesteps
+    if not 1 <= start_step <= nsteps:
+        raise ValueError(
+            f"start_step must be in [1, {nsteps}], got {start_step}"
+        )
+    errors = _error_fn(problem, dtype)
+
+    def run(u_prev, u_cur):
+        (u_p, u_c), (abs_t, rel_t) = _scan_layers(
+            problem, step, errors, compute_errors, dtype,
+            u_prev, u_cur, start_step, nsteps,
+        )
+        head = jnp.zeros((start_step + 1,), dtype)
+        return (
+            u_p,
+            u_c,
+            jnp.concatenate([head, abs_t]),
+            jnp.concatenate([head, rel_t]),
+        )
+
+    args = (jnp.asarray(u_prev, dtype), jnp.asarray(u_cur, dtype))
+    (u_p, u_c, abs_all, rel_all), init_s, solve_s = _timed_compile_run(
+        jax.jit(run), args
+    )
+    return SolveResult(
+        problem=problem,
+        u_prev=u_p,
+        u_cur=u_c,
+        abs_errors=np.asarray(abs_all, dtype=np.float64),
+        rel_errors=np.asarray(rel_all, dtype=np.float64),
+        init_seconds=init_s,
+        solve_seconds=solve_s,
+        steps_computed=nsteps - start_step,
+        final_step=nsteps,
     )
 
 

@@ -1,0 +1,131 @@
+"""Phase-timing probes: loop (stencil) vs halo-exchange cost.
+
+The reference accumulates `total_loop_time` / `total_exchange_time` with
+host timers around each phase of every step (mpi_new.cpp:33-34, 200-240,
+368-371).  A TPU program cannot be timed that way - the whole solve is one
+fused XLA computation with no host boundary to put a timer on (that fusion
+IS the design, solver/sharded.py).  Instead, the breakdown is measured the
+way one profiles jitted code: two probe programs over identical state,
+
+  * full   - halo exchange (`ppermute`) + stencil update, the real step body;
+  * compute - the same stencil with a zero-ghost local pad instead of the
+    exchange (identical FLOPs and memory traffic shape, no ICI);
+
+each run as a `lax.scan` of `iters` steps inside one jitted shard_map call.
+`exchange = full - compute` (clamped at 0: on a single-superchip mesh the
+difference sits inside timer noise).  The numbers feed the report writer's
+"total ICI exchange time" / "total loop time" lines so output files stay
+diffable against the reference's.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from wavetpu.comm import halo
+from wavetpu.core.grid import AXIS_NAMES, Topology, build_mesh, choose_mesh_shape
+from wavetpu.core.problem import Problem
+from wavetpu.kernels import stencil_ref
+
+
+@dataclasses.dataclass(frozen=True)
+class PhaseBreakdown:
+    """Per-solve phase attribution, scaled to `timesteps` steps."""
+
+    loop_seconds: float       # stencil update cost (compute probe)
+    exchange_seconds: float   # halo `ppermute` cost (full - compute, >= 0)
+    steps_measured: int       # probe scan length behind the extrapolation
+
+    @property
+    def total_seconds(self) -> float:
+        return self.loop_seconds + self.exchange_seconds
+
+
+def _probe_runner(problem: Problem, topo: Topology, mesh, dtype, with_halo,
+                  iters: int):
+    """Jitted scan of `iters` leapfrog steps over the sharded state."""
+    c_full = problem.a2tau2
+    inv_h2 = problem.inv_h2
+
+    def local(u_prev, u):
+        def body(carry, _):
+            u_prev, u = carry
+            if with_halo:
+                ext = halo.halo_extend(u, topo)
+            else:
+                ext = jnp.pad(u, 1)
+            lap = stencil_ref.laplacian_ext(ext, inv_h2)
+            u_next = 2.0 * u - u_prev + jnp.asarray(c_full, dtype) * lap
+            return (u, u_next), None
+
+        (u_prev, u), _ = jax.lax.scan(body, (u_prev, u), None, length=iters)
+        return u_prev, u
+
+    spec = P(*AXIS_NAMES)
+    return jax.jit(
+        jax.shard_map(
+            local, mesh=mesh, in_specs=(spec, spec), out_specs=(spec, spec)
+        )
+    )
+
+
+def _time_best(fn, args, repeats: int) -> float:
+    """Best-of-N wall time of the compiled callable (compile excluded)."""
+    out = fn(*args)  # compile + warm up
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def measure_phase_breakdown(
+    problem: Problem,
+    mesh_shape: Optional[Tuple[int, int, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    dtype=jnp.float32,
+    iters: int = 10,
+    repeats: int = 3,
+) -> PhaseBreakdown:
+    """Measure the loop/exchange split and scale it to the full solve length.
+
+    Runs on zero state - leapfrog cost is data-independent, and the probes
+    exist for timing, not numerics.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if mesh_shape is None:
+        mesh_shape = choose_mesh_shape(len(devices))
+    topo = Topology(N=problem.N, mesh_shape=mesh_shape)
+    mesh = build_mesh(mesh_shape, devices[: topo.n_devices])
+
+    shape = topo.padded
+    u_prev = jnp.zeros(shape, dtype)
+    u = jnp.zeros(shape, dtype)
+    sharding = jax.sharding.NamedSharding(mesh, P(*AXIS_NAMES))
+    u_prev = jax.device_put(u_prev, sharding)
+    u = jax.device_put(u, sharding)
+
+    t_full = _time_best(
+        _probe_runner(problem, topo, mesh, dtype, True, iters),
+        (u_prev, u), repeats,
+    )
+    t_comp = _time_best(
+        _probe_runner(problem, topo, mesh, dtype, False, iters),
+        (u_prev, u), repeats,
+    )
+    scale = problem.timesteps / iters
+    return PhaseBreakdown(
+        loop_seconds=t_comp * scale,
+        exchange_seconds=max(0.0, (t_full - t_comp)) * scale,
+        steps_measured=iters,
+    )
