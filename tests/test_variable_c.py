@@ -1,0 +1,142 @@
+"""Variable wave speed c(x,y,z) and bf16-state/fp32-accum mode.
+
+BASELINE.md stretch config 5.  The variable-c update is a capability
+extension over the reference (its a^2 is a hardcoded __constant__,
+openmp_sol.cpp:207, cuda_sol_kernels.cu:3); the constant-field case must
+collapse to the scalar path exactly, which pins the new code to the tested
+one.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wavetpu.kernels import stencil_pallas, stencil_ref
+from wavetpu.solver import leapfrog
+
+
+def _c2_bump(problem):
+    """A smooth positive speed-squared field, max value a2 (so the constant-
+    speed Courant bound still guarantees stability)."""
+
+    def fn(x, y, z):
+        return problem.a2 * (
+            0.5 + 0.5 * np.sin(2 * np.pi * x) * np.sin(np.pi * y) ** 2
+        ) / 1.0
+
+    return stencil_ref.make_c2tau2_field(problem, fn)
+
+
+def test_constant_field_matches_scalar_path(small_problem):
+    """c^2(x,y,z) == a^2 everywhere must reproduce the scalar solver."""
+    field = stencil_ref.make_c2tau2_field(
+        small_problem, lambda x, y, z: small_problem.a2
+    )
+    assert field == pytest.approx(small_problem.a2tau2)
+    ref = leapfrog.solve(small_problem)
+    var = leapfrog.solve(
+        small_problem, step_fn=stencil_ref.make_variable_c_step(field)
+    )
+    np.testing.assert_allclose(
+        np.asarray(var.u_cur), np.asarray(ref.u_cur), atol=1e-7, rtol=0.0
+    )
+
+
+def test_variable_c_stays_finite(small_problem):
+    field = _c2_bump(small_problem)
+    res = leapfrog.solve(
+        small_problem,
+        step_fn=stencil_ref.make_variable_c_step(field),
+        compute_errors=False,
+    )
+    u = np.asarray(res.u_cur)
+    assert np.isfinite(u).all()
+    # The field genuinely varies, and the solution differs from constant-c.
+    ref = leapfrog.solve(small_problem, compute_errors=False)
+    assert np.max(np.abs(u - np.asarray(ref.u_cur))) > 1e-6
+    # Dirichlet invariant survives the variable-c update.
+    assert np.all(u[:, 0, :] == 0.0)
+    assert np.all(u[:, :, 0] == 0.0)
+
+
+def test_variable_c_bootstrap_uses_field(small_problem):
+    """Layer 1 must be u0 + (tau^2 c^2(x)/2) lap(u0) with the FIELD, not the
+    constant a^2 (make_solver derives it from the step function)."""
+    from wavetpu.core.problem import Problem
+
+    field = _c2_bump(small_problem)
+    p1 = Problem(
+        N=small_problem.N, timesteps=1, T=small_problem.T / small_problem.timesteps
+    )  # same tau; scan range empty, so u_cur == layer 1
+    field1 = _c2_bump(p1)
+    res = leapfrog.solve(
+        p1,
+        step_fn=stencil_ref.make_variable_c_step(field1),
+        compute_errors=False,
+    )
+    u0 = leapfrog.initial_layer0(p1)
+    lap = stencil_ref.laplacian(u0, p1.inv_h2)
+    want = stencil_ref.apply_dirichlet(
+        u0 + 0.5 * jnp.asarray(field1, u0.dtype) * lap
+    )
+    np.testing.assert_allclose(
+        np.asarray(res.u_cur), np.asarray(want), atol=1e-7, rtol=0.0
+    )
+
+
+def test_pallas_variable_c_matches_ref(small_problem):
+    field = _c2_bump(small_problem)
+    rng = np.random.default_rng(3)
+    n = small_problem.N
+    u_prev = stencil_ref.apply_dirichlet(
+        jnp.asarray(rng.standard_normal((n, n, n)), jnp.float32)
+    )
+    u = stencil_ref.apply_dirichlet(
+        jnp.asarray(rng.standard_normal((n, n, n)), jnp.float32)
+    )
+    want = stencil_ref.make_variable_c_step(field)(u_prev, u, small_problem)
+    got = stencil_pallas.make_step_fn(
+        block_x=2, interpret=True, c2tau2_field=field
+    )(u_prev, u, small_problem)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-6, rtol=1e-6
+    )
+
+
+def test_bf16_state_f32_accum(small_problem):
+    """bf16 state stays stable and lands within bf16-resolution of f32."""
+    res16 = leapfrog.solve(small_problem, dtype=jnp.bfloat16)
+    res32 = leapfrog.solve(small_problem, dtype=jnp.float32)
+    assert res16.u_cur.dtype == jnp.bfloat16
+    u16 = np.asarray(res16.u_cur, dtype=np.float32)
+    u32 = np.asarray(res32.u_cur)
+    assert np.isfinite(u16).all()
+    # bf16 has ~3 decimal digits; the trajectory should track f32 loosely.
+    assert np.max(np.abs(u16 - u32)) < 0.05
+    # Error oracle evaluates in f32 (not quantized to bf16).
+    assert res16.abs_errors.dtype == np.float64
+    assert res16.abs_errors.max() < 0.05
+
+
+def test_bf16_pallas_step_matches_ref_step(small_problem):
+    rng = np.random.default_rng(4)
+    n = small_problem.N
+    u_prev = stencil_ref.apply_dirichlet(
+        jnp.asarray(rng.standard_normal((n, n, n)), jnp.bfloat16)
+    )
+    u = stencil_ref.apply_dirichlet(
+        jnp.asarray(rng.standard_normal((n, n, n)), jnp.bfloat16)
+    )
+    want = stencil_ref.leapfrog_step(u_prev, u, small_problem)
+    got = stencil_pallas.leapfrog_step(
+        u_prev, u, small_problem, block_x=2, interpret=True
+    )
+    assert got.dtype == jnp.bfloat16
+    # Both compute in f32 and round once to bf16: results should agree to
+    # 1 bf16 ulp.
+    np.testing.assert_allclose(
+        np.asarray(got, dtype=np.float32),
+        np.asarray(want, dtype=np.float32),
+        atol=0.01,
+        rtol=0.01,
+    )

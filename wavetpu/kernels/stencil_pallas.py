@@ -42,6 +42,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from wavetpu.core.problem import Problem
+from wavetpu.kernels import stencil_ref
 
 # Per-core VMEM working-set budget (bytes) used to pick block_x: the
 # pipeline double-buffers (3*bx + 2) planes (u slab + u_prev slab + out slab
@@ -68,6 +69,33 @@ def choose_block_x(n: int, itemsize: int = 4) -> int:
     return bx
 
 
+def _slab_laplacian(c, ulo_ref, uhi_ref, inv_h2, f):
+    """7-pt Laplacian of a slab: x-neighbours from the halo-plane refs,
+    y/z neighbours from in-VMEM cyclic rolls (the wrap delivers the stored
+    zero Dirichlet plane / the periodic value - rolls ARE the BC)."""
+    ix, iy, iz = (jnp.asarray(v, f) for v in inv_h2)
+    # Halo planes stacked onto the slab (axis 0 is neither lane nor sublane,
+    # so this is free of relayouts).
+    ext = jnp.concatenate([ulo_ref[:].astype(f), c, uhi_ref[:].astype(f)], 0)
+    lap = (ext[:-2] + ext[2:] - 2.0 * c) * ix
+    # pltpu.roll wants non-negative shifts: roll by size-1 == roll by -1.
+    ny, nz = c.shape[1], c.shape[2]
+    lap = lap + (pltpu.roll(c, 1, 1) + pltpu.roll(c, ny - 1, 1) - 2.0 * c) * iy
+    lap = lap + (pltpu.roll(c, 1, 2) + pltpu.roll(c, nz - 1, 2) - 2.0 * c) * iz
+    return lap
+
+
+def _finish_update(u_next, out_ref, f):
+    """Fused Dirichlet mask + store: zero the stored y=0 / z=0 planes (the
+    reference's whole `prepare_layer` pass, openmp_sol.cpp:104-112)."""
+    shape = u_next.shape
+    ym = lax.broadcasted_iota(jnp.int32, shape, 1) != 0
+    zm = lax.broadcasted_iota(jnp.int32, shape, 2) != 0
+    out_ref[:] = jnp.where(
+        ym & zm, u_next, jnp.asarray(0.0, f)
+    ).astype(out_ref.dtype)
+
+
 def _step_kernel(uprev_ref, uc_ref, ulo_ref, uhi_ref, out_ref,
                  *, alpha, beta, coeff, inv_h2, compute_dtype):
     """One fused update slab: out = alpha*u - beta*u_prev + coeff*lap(u).
@@ -78,58 +106,77 @@ def _step_kernel(uprev_ref, uc_ref, ulo_ref, uhi_ref, out_ref,
     """
     f = compute_dtype
     c = uc_ref[:].astype(f)
-    ix, iy, iz = (jnp.asarray(v, f) for v in inv_h2)
-    # x-neighbours: halo planes stacked onto the slab (axis 0 is neither
-    # lane nor sublane, so this is free of relayouts).
-    ext = jnp.concatenate([ulo_ref[:].astype(f), c, uhi_ref[:].astype(f)], 0)
-    lap = (ext[:-2] + ext[2:] - 2.0 * c) * ix
-    # y/z neighbours: cyclic rolls ARE the boundary condition (the wrap
-    # delivers the stored zero Dirichlet plane / the periodic value).
-    # pltpu.roll wants non-negative shifts: roll by size-1 == roll by -1.
-    ny, nz = c.shape[1], c.shape[2]
-    lap = lap + (pltpu.roll(c, 1, 1) + pltpu.roll(c, ny - 1, 1) - 2.0 * c) * iy
-    lap = lap + (pltpu.roll(c, 1, 2) + pltpu.roll(c, nz - 1, 2) - 2.0 * c) * iz
+    lap = _slab_laplacian(c, ulo_ref, uhi_ref, inv_h2, f)
     u_next = jnp.asarray(alpha, f) * c + jnp.asarray(coeff, f) * lap
     if beta:
         u_next = u_next - jnp.asarray(beta, f) * uprev_ref[:].astype(f)
-    # Fused Dirichlet: zero the stored y=0 / z=0 planes (the reference's
-    # whole `prepare_layer` pass, openmp_sol.cpp:104-112).
-    shape = u_next.shape
-    ym = lax.broadcasted_iota(jnp.int32, shape, 1) != 0
-    zm = lax.broadcasted_iota(jnp.int32, shape, 2) != 0
-    u_next = jnp.where(ym & zm, u_next, jnp.asarray(0.0, f))
-    out_ref[:] = u_next.astype(out_ref.dtype)
+    _finish_update(u_next, out_ref, f)
 
 
-def _fused_step(u_prev, u, *, alpha, beta, coeff, inv_h2,
-                block_x=None, interpret=False,
-                compute_dtype=jnp.float32):
-    n = u.shape[0]
-    bx = block_x or choose_block_x(n, u.dtype.itemsize)
-    if n % bx:
-        raise ValueError(f"block_x={bx} must divide N={n}")
+def _var_step_kernel(c2_ref, uprev_ref, uc_ref, ulo_ref, uhi_ref, out_ref,
+                     *, inv_h2, compute_dtype):
+    """Variable-speed leapfrog slab: out = 2u - u_prev + tau^2 c^2(x) lap(u).
+
+    The c^2 tau^2 field rides its own slab input - the capability extension
+    over the reference's hardcoded __constant__ a2 (cuda_sol_kernels.cu:3)."""
+    f = compute_dtype
+    c = uc_ref[:].astype(f)
+    lap = _slab_laplacian(c, ulo_ref, uhi_ref, inv_h2, f)
+    u_next = 2.0 * c - uprev_ref[:].astype(f) + c2_ref[:].astype(f) * lap
+    _finish_update(u_next, out_ref, f)
+
+
+def _specs(n: int, bx: int):
+    """Slab + wrap-around halo BlockSpecs for an (N, N, N) field.
+
+    Single-plane halos via wrap-around maps: with block shape (1, N, N)
+    the x block index IS the plane index, so these express the cyclic
+    neighbour relation directly (jnp mod is floor-mod: (0-1) % N = N-1).
+    """
     slab = pl.BlockSpec((bx, n, n), lambda i: (i, 0, 0),
                         memory_space=pltpu.VMEM)
-    # Single-plane halos via wrap-around maps: with block shape (1, N, N)
-    # the x block index IS the plane index, so these express the cyclic
-    # neighbour relation directly (jnp mod is floor-mod: (0-1) % N = N-1).
     lo = pl.BlockSpec((1, n, n), lambda i: ((i * bx - 1) % n, 0, 0),
                       memory_space=pltpu.VMEM)
     hi = pl.BlockSpec((1, n, n), lambda i: (((i + 1) * bx) % n, 0, 0),
                       memory_space=pltpu.VMEM)
-    kernel = functools.partial(
-        _step_kernel, alpha=alpha, beta=beta, coeff=coeff,
-        inv_h2=inv_h2, compute_dtype=compute_dtype,
-    )
+    return slab, lo, hi
+
+
+def _fused_step(u_prev, u, *, inv_h2, alpha=2.0, beta=1.0, coeff=None,
+                c2tau2_field=None, block_x=None, interpret=False,
+                compute_dtype=None):
+    """Shared pallas_call wrapper for the constant- and variable-speed
+    kernels; `c2tau2_field` selects the variable kernel (its slab is
+    prepended as an extra input)."""
+    n = u.shape[0]
+    bx = block_x or choose_block_x(n, u.dtype.itemsize)
+    if n % bx:
+        raise ValueError(f"block_x={bx} must divide N={n}")
+    if compute_dtype is None:
+        compute_dtype = stencil_ref.compute_dtype(u.dtype)
+    slab, lo, hi = _specs(n, bx)
+    if c2tau2_field is None:
+        kernel = functools.partial(
+            _step_kernel, alpha=alpha, beta=beta, coeff=coeff,
+            inv_h2=inv_h2, compute_dtype=compute_dtype,
+        )
+        in_specs, operands = [slab, slab, lo, hi], (u_prev, u, u, u)
+    else:
+        kernel = functools.partial(
+            _var_step_kernel, inv_h2=inv_h2, compute_dtype=compute_dtype,
+        )
+        field = jnp.asarray(c2tau2_field, dtype=compute_dtype)
+        in_specs = [slab, slab, slab, lo, hi]
+        operands = (field, u_prev, u, u, u)
     return pl.pallas_call(
         kernel,
         grid=(n // bx,),
-        in_specs=[slab, slab, lo, hi],
+        in_specs=in_specs,
         out_specs=slab,
         out_shape=jax.ShapeDtypeStruct(u.shape, u.dtype),
         compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
-    )(u_prev, u, u, u)
+    )(*operands)
 
 
 def leapfrog_step(u_prev, u, problem: Problem, *,
@@ -155,10 +202,28 @@ def taylor_half_step(u0, problem: Problem, *, block_x=None, interpret=False):
     )
 
 
-def make_step_fn(block_x=None, interpret=False):
+def make_step_fn(block_x=None, interpret=False, c2tau2_field=None):
     """A `(u_prev, u, problem) -> u_next` closure for `make_solver(step_fn=)`
-    with the kernel tuning parameters bound."""
-    def step(u_prev, u, problem):
-        return leapfrog_step(u_prev, u, problem,
-                             block_x=block_x, interpret=interpret)
-    return step
+    with the kernel tuning parameters bound.
+
+    With `c2tau2_field` (see `stencil_ref.make_c2tau2_field`) the update uses
+    the spatially varying wave speed kernel and returns a `ParamStep` so the
+    field is a runtime argument of the jitted program, not a baked-in
+    constant (see solver.leapfrog.ParamStep); the analytic oracle only holds
+    for constant speed, so pair it with compute_errors=False.
+    """
+    if c2tau2_field is None:
+        def step(u_prev, u, problem):
+            return leapfrog_step(u_prev, u, problem,
+                                 block_x=block_x, interpret=interpret)
+        return step
+
+    from wavetpu.solver.leapfrog import ParamStep
+
+    def var_step(u_prev, u, problem, field):
+        return _fused_step(
+            u_prev, u, c2tau2_field=field, inv_h2=problem.inv_h2,
+            block_x=block_x, interpret=interpret,
+        )
+
+    return ParamStep(var_step, jnp.asarray(c2tau2_field))

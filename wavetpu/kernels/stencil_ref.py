@@ -22,9 +22,18 @@ it to rounding error on identical inputs.
 
 from __future__ import annotations
 
+from typing import Callable
+
 import jax.numpy as jnp
+import numpy as np
 
 from wavetpu.core.problem import Problem
+
+
+def compute_dtype(dtype):
+    """bf16 state computes in f32 (the BASELINE.md stretch contract:
+    bf16 storage + fp32 accumulation); everything else computes as stored."""
+    return jnp.float32 if dtype == jnp.bfloat16 else dtype
 
 
 def laplacian(u, inv_h2):
@@ -50,11 +59,14 @@ def leapfrog_step(u_prev, u, problem: Problem):
     """u_next = 2u - u_prev + a^2 tau^2 lap(u), Dirichlet re-imposed.
 
     The uniform interior update of the reference (openmp_sol.cpp:160) which,
-    on the fundamental domain, also covers the periodic seam.
+    on the fundamental domain, also covers the periodic seam.  bf16 state
+    computes in f32 and stores back in bf16.
     """
-    c = jnp.asarray(problem.a2tau2, dtype=u.dtype)
-    u_next = 2.0 * u - u_prev + c * laplacian(u, problem.inv_h2)
-    return apply_dirichlet(u_next)
+    f = compute_dtype(u.dtype)
+    uc = u.astype(f)
+    c = jnp.asarray(problem.a2tau2, dtype=f)
+    u_next = 2.0 * uc - u_prev.astype(f) + c * laplacian(uc, problem.inv_h2)
+    return apply_dirichlet(u_next).astype(u.dtype)
 
 
 def taylor_half_step(u0, problem: Problem):
@@ -64,9 +76,59 @@ def taylor_half_step(u0, problem: Problem):
     openmp_sol.cpp:117 (factor 1 on u0, none on u^{-1}, half on the Laplacian),
     which are exactly this formula.
     """
-    c = jnp.asarray(0.5 * problem.a2tau2, dtype=u0.dtype)
-    u1 = u0 + c * laplacian(u0, problem.inv_h2)
-    return apply_dirichlet(u1)
+    f = compute_dtype(u0.dtype)
+    uc = u0.astype(f)
+    c = jnp.asarray(0.5 * problem.a2tau2, dtype=f)
+    u1 = uc + c * laplacian(uc, problem.inv_h2)
+    return apply_dirichlet(u1).astype(u0.dtype)
+
+
+def make_c2tau2_field(
+    problem: Problem, c2_fn: Callable[[np.ndarray, np.ndarray, np.ndarray], np.ndarray]
+) -> np.ndarray:
+    """Evaluate tau^2 * c^2(x, y, z) on the fundamental grid, host-side f64.
+
+    `c2_fn` takes broadcastable (x, y, z) coordinate arrays and returns the
+    squared wave speed.  The constant-speed problem is `c2_fn = lambda
+    x, y, z: problem.a2`; the result then equals `problem.a2tau2` everywhere
+    (pinned by tests/test_variable_c.py).
+
+    Variable wave speed is a capability extension over the reference (its
+    a^2 is hardcoded, openmp_sol.cpp:207); the analytic oracle only holds
+    for constant speed, so variable-c runs should pass compute_errors=False.
+    """
+    n = problem.N
+    x = (np.arange(n, dtype=np.float64) * problem.hx)[:, None, None]
+    y = (np.arange(n, dtype=np.float64) * problem.hy)[None, :, None]
+    z = (np.arange(n, dtype=np.float64) * problem.hz)[None, None, :]
+    c2 = np.broadcast_to(
+        np.asarray(c2_fn(x, y, z), dtype=np.float64), (n, n, n)
+    )
+    return c2 * problem.tau**2
+
+
+def make_variable_c_step(c2tau2_field):
+    """A solver step with spatially varying speed:
+    u_next = 2u - u_prev + tau^2 c^2(x,y,z) lap(u).
+
+    Returns a `ParamStep`: the field rides through the jitted program as a
+    runtime argument (closing over it would embed an N^3 HLO literal -
+    512 MB at N=512; see solver.leapfrog.ParamStep).  Slots into
+    `make_solver(step_fn=...)` like any other kernel, or call it directly
+    as `(u_prev, u, problem)`.
+    """
+    from wavetpu.solver.leapfrog import ParamStep
+
+    def step(u_prev, u, problem: Problem, field):
+        f = compute_dtype(u.dtype)
+        uc = u.astype(f)
+        coeff = jnp.asarray(field, dtype=f)
+        u_next = (
+            2.0 * uc - u_prev.astype(f) + coeff * laplacian(uc, problem.inv_h2)
+        )
+        return apply_dirichlet(u_next).astype(u.dtype)
+
+    return ParamStep(step, jnp.asarray(np.asarray(c2tau2_field)))
 
 
 def laplacian_ext(ext, inv_h2):

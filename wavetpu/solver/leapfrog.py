@@ -22,7 +22,7 @@ from __future__ import annotations
 import dataclasses
 import time
 from functools import partial
-from typing import Callable, Optional, Tuple
+from typing import Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -56,37 +56,89 @@ class SolveResult:
         return total / self.solve_seconds / 1e9 if self.solve_seconds else 0.0
 
 
+class ParamStep(NamedTuple):
+    """A step function with runtime array parameters.
+
+    `fn(u_prev, u, problem, params) -> u_next`; `params` (a pytree of
+    arrays, e.g. the variable-c field) is threaded through the jitted
+    program as a runtime ARGUMENT, not closed over.  Closing over a large
+    field would embed it as an HLO literal - at N=512 that is a 512 MB
+    constant, which this image's remote-compile tunnel rejects outright
+    (HTTP 413) and which any backend would recompile per field.
+    """
+
+    fn: Callable
+    params: object
+
+    def __call__(self, u_prev, u, problem):
+        """Direct use outside a solver (tests, one-off steps)."""
+        return self.fn(u_prev, u, problem, self.params)
+
+
+def _as_param_step(step_fn):
+    """Normalize the three accepted step_fn forms to (fn4, params)."""
+    if step_fn is None:
+        return (
+            lambda up, u, p, _: stencil_ref.leapfrog_step(up, u, p)
+        ), ()
+    if isinstance(step_fn, ParamStep):
+        return step_fn.fn, step_fn.params
+    return (lambda up, u, p, _, f=step_fn: f(up, u, p)), ()
+
+
 def _error_fn(problem: Problem, dtype):
-    """Returns (u, n) -> (abs_e, rel_e) with precomputed factors closed over."""
-    sx, sy, sz = oracle.spatial_factors(problem, dtype)
-    ct_table = oracle.time_factor_table(problem, dtype)
+    """Returns (u, n) -> (abs_e, rel_e) with precomputed factors closed over.
+
+    The oracle always evaluates in the compute dtype (f32 for bf16 state):
+    the error should measure the solver, not the bf16 quantization of the
+    analytic field.
+    """
+    f_dtype = stencil_ref.compute_dtype(dtype)
+    sx, sy, sz = oracle.spatial_factors(problem, f_dtype)
+    ct_table = oracle.time_factor_table(problem, f_dtype)
     mask = jnp.asarray(oracle.interior_masks_1d(problem.N))
 
     def errors(u, n):
         f = oracle.analytic_field(sx, sy, sz, ct_table[n])
-        return oracle.layer_errors(u, f, mask, mask, mask)
+        return oracle.layer_errors(u.astype(f_dtype), f, mask, mask, mask)
 
     return errors
 
 
+def initial_layer0(problem: Problem, dtype=jnp.float32) -> jax.Array:
+    """Layer 0: the analytic solution at t=0, Dirichlet re-imposed.
+
+    Reference: the layer-0 fill of `calculate_start` (openmp_sol.cpp:126-133).
+    bf16 state evaluates in f32 and rounds once.
+    """
+    f = stencil_ref.compute_dtype(dtype)
+    sx, sy, sz = oracle.spatial_factors(problem, f)
+    ct0 = oracle.time_factor(problem, 0, f)
+    u0 = oracle.analytic_field(sx, sy, sz, ct0)
+    return stencil_ref.apply_dirichlet(u0).astype(dtype)
+
+
 def initial_state(problem: Problem, dtype=jnp.float32) -> Tuple[jax.Array, jax.Array]:
-    """Layers 0 and 1: analytic init + Taylor half-step.
+    """Layers 0 and 1: analytic init + (constant-speed) Taylor half-step.
 
     Reference: `calculate_start` (openmp_sol.cpp:123-145).  Layer 0 fills the
     whole grid from the analytic solution; layer 1 is the half-step
     u1 = u0 + (a^2 tau^2 / 2) lap(u0), with boundary planes re-imposed.
+    bf16 state bootstraps in f32 and rounds once at the end.
+
+    Note: `make_solver` derives layer 1 from its step function instead (so
+    variable-c kernels bootstrap with their own field); this helper is the
+    standalone constant-speed form for tests and the driver entry hook.
     """
-    sx, sy, sz = oracle.spatial_factors(problem, dtype)
-    ct0 = oracle.time_factor(problem, 0, dtype)
-    u0 = oracle.analytic_field(sx, sy, sz, ct0)
-    u0 = stencil_ref.apply_dirichlet(u0)
+    u0 = initial_layer0(problem, dtype)
     u1 = stencil_ref.taylor_half_step(u0, problem)
-    return u0, u1
+    return u0, u1.astype(dtype)
 
 
 def _scan_layers(
     problem: Problem,
     step: Callable,
+    step_params,
     errors: Callable,
     compute_errors: bool,
     dtype,
@@ -103,13 +155,15 @@ def _scan_layers(
     tests/test_checkpoint.py).
     """
 
+    err_dtype = stencil_ref.compute_dtype(dtype)
+
     def body(carry, n):
         u_prev, u = carry
-        u_next = step(u_prev, u, problem)
+        u_next = step(u_prev, u, problem, step_params)
         if compute_errors:
             ae, re = errors(u_next, n)
         else:
-            ae = re = jnp.zeros((), dtype)
+            ae = re = jnp.zeros((), err_dtype)
         return (u, u_next), (ae, re)
 
     return jax.lax.scan(body, (u_prev, u_cur), jnp.arange(start + 1, stop + 1))
@@ -133,18 +187,29 @@ def make_solver(
     step_fn: Optional[Callable] = None,
     compute_errors: bool = True,
     stop_step: Optional[int] = None,
-) -> Callable[[], Tuple[jax.Array, jax.Array, jax.Array, jax.Array]]:
-    """Build the jitted end-to-end solver (no runtime array inputs).
+) -> Tuple[Callable, object]:
+    """Build the jitted end-to-end solver.
+
+    Returns `(runner, step_params)`; call `runner(step_params)`.  For the
+    default and plain-step paths `step_params` is just `()`; a `ParamStep`
+    kernel's array parameters (e.g. the variable-c field) ride through as
+    runtime arguments (see ParamStep for why they must not be closed over).
 
     `step_fn(u_prev, u, problem) -> u_next` defaults to the jnp-roll stencil;
-    the Pallas kernel slots in via the same signature.
+    the Pallas kernel slots in via the same signature, and `ParamStep` adds
+    a params argument.
+
+    Layer 1 is derived FROM the step function - u1 = (u0 + step(u0, u0))/2
+    equals the Taylor half-step u0 + (coeff/2)*lap(u0) for any leapfrog-form
+    kernel - so a variable-c kernel bootstraps with its own c^2(x,y,z), not
+    the constant a^2 (reference: openmp_sol.cpp:137-144).
 
     `stop_step` halts the march after that layer (default: run to
     `problem.timesteps`).  tau stays `T / timesteps` regardless, so a stopped
     run is the exact prefix of the full one - the state a checkpoint captures
     (io/checkpoint.py) and `resume` continues from.
     """
-    step = step_fn or stencil_ref.leapfrog_step
+    step, step_params = _as_param_step(step_fn)
     errors = _error_fn(problem, dtype)
     nsteps = problem.timesteps if stop_step is None else stop_step
     if not 1 <= nsteps <= problem.timesteps:
@@ -152,28 +217,34 @@ def make_solver(
             f"stop_step must be in [1, {problem.timesteps}], got {nsteps}"
         )
 
-    def run():
-        u0, u1 = initial_state(problem, dtype)
+    def run(step_params):
+        u0 = initial_layer0(problem, dtype)
+        f = stencil_ref.compute_dtype(dtype)
+        u1 = (
+            0.5 * (u0.astype(f) + step(u0, u0, problem, step_params).astype(f))
+        ).astype(dtype)
         # Layer 0 is *assigned from* the oracle, so its error is zero by
         # definition; the reference reads back the memory it just wrote and
         # reports exactly 0 (openmp_sol.cpp:126-133, 169-190).  Recomputing
         # the analytic product here and subtracting would measure XLA's FMA
         # rematerialization noise (~1 ulp), not solver error - u0's
         # correctness is pinned by tests/test_single_device.py instead.
-        a0 = r0 = jnp.zeros((), dtype)
+        err_dtype = stencil_ref.compute_dtype(dtype)
+        a0 = r0 = jnp.zeros((), err_dtype)
         if compute_errors:
             a1, r1 = errors(u1, 1)
         else:
-            a1 = r1 = jnp.zeros((), dtype)
+            a1 = r1 = jnp.zeros((), err_dtype)
 
         (u_prev, u_cur), (abs_t, rel_t) = _scan_layers(
-            problem, step, errors, compute_errors, dtype, u0, u1, 1, nsteps
+            problem, step, step_params, errors, compute_errors, dtype,
+            u0, u1, 1, nsteps,
         )
         abs_all = jnp.concatenate([jnp.stack([a0, a1]), abs_t])
         rel_all = jnp.concatenate([jnp.stack([r0, r1]), rel_t])
         return u_prev, u_cur, abs_all, rel_all
 
-    return jax.jit(run)
+    return jax.jit(run), step_params
 
 
 def solve(
@@ -189,9 +260,11 @@ def solve(
     part of the program); "numerical solution calculated in Xms" is the
     execution wall time (mpi_new.cpp:472-474, 354-357).
     """
-    runner = make_solver(problem, dtype, step_fn, compute_errors, stop_step)
+    runner, step_params = make_solver(
+        problem, dtype, step_fn, compute_errors, stop_step
+    )
     (u_prev, u_cur, abs_all, rel_all), init_s, solve_s = _timed_compile_run(
-        runner
+        runner, (step_params,)
     )
     return SolveResult(
         problem=problem,
@@ -226,7 +299,7 @@ def resume(
     The returned error arrays cover layers start_step+1..timesteps; earlier
     entries are zero (they belong to the pre-checkpoint run's report).
     """
-    step = step_fn or stencil_ref.leapfrog_step
+    step, step_params = _as_param_step(step_fn)
     nsteps = problem.timesteps
     if not 1 <= start_step <= nsteps:
         raise ValueError(
@@ -234,12 +307,12 @@ def resume(
         )
     errors = _error_fn(problem, dtype)
 
-    def run(u_prev, u_cur):
+    def run(u_prev, u_cur, step_params):
         (u_p, u_c), (abs_t, rel_t) = _scan_layers(
-            problem, step, errors, compute_errors, dtype,
+            problem, step, step_params, errors, compute_errors, dtype,
             u_prev, u_cur, start_step, nsteps,
         )
-        head = jnp.zeros((start_step + 1,), dtype)
+        head = jnp.zeros((start_step + 1,), stencil_ref.compute_dtype(dtype))
         return (
             u_p,
             u_c,
@@ -247,7 +320,7 @@ def resume(
             jnp.concatenate([head, rel_t]),
         )
 
-    args = (jnp.asarray(u_prev, dtype), jnp.asarray(u_cur, dtype))
+    args = (jnp.asarray(u_prev, dtype), jnp.asarray(u_cur, dtype), step_params)
     (u_p, u_c, abs_all, rel_all), init_s, solve_s = _timed_compile_run(
         jax.jit(run), args
     )

@@ -127,8 +127,12 @@ def make_sharded_solver(
         # definition (see solver/leapfrog.py for the rationale and the XLA
         # rematerialization-noise trap this avoids).
         a0 = r0 = jnp.zeros((), dtype)
-        # Layer 1: Taylor half-step u1 = u0 + c/2 lap(u0) (mpi_new.cpp:300-316).
-        u1 = step(u0, u0, jnp.asarray(0.5 * c_full, dtype)) * bc
+        # Layer 1 Taylor half-step, derived from the full step exactly as
+        # the single-device solver does (u1 = (u0 + leapfrog(u0, u0))/2 ==
+        # u0 + c/2 lap(u0); mpi_new.cpp:300-316) so the two backends stay
+        # bitwise-comparable (tests/test_sharded.py's 1e-9 rtol).
+        s = step(2.0 * u0 - u0, u0, jnp.asarray(c_full, dtype))
+        u1 = (0.5 * (u0 + s)) * bc
         a1, r1 = errors(u1, 1)
 
         def body(carry, n):
