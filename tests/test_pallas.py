@@ -88,3 +88,13 @@ def test_choose_block_x():
     assert stencil_pallas.choose_block_x(512) == 8
     assert stencil_pallas.choose_block_x(1024) == 1
     assert stencil_pallas.choose_block_x(128) == 8
+    # The variable-c kernel has one more bx-deep slab in flight, so the
+    # budget admits a shallower slab (measured cliff on v5e, see docstring).
+    assert stencil_pallas.choose_block_x(512, field_itemsize=4) == 4
+    # bf16 state still carries an f32 field slab - it must be counted at
+    # the compute width, not the state width.
+    assert (
+        stencil_pallas.choose_block_x(512, itemsize=2, field_itemsize=4) == 8
+    )
+    full = 2 * ((3 * 2 + 4) * 8 + 2 * 2) * 512 * 512
+    assert full <= stencil_pallas._VMEM_BUDGET

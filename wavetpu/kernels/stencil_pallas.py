@@ -34,6 +34,7 @@ chip): identical inputs must agree to rounding error.
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -55,15 +56,27 @@ _VMEM_BUDGET = 56 * 1024 * 1024
 _VMEM_LIMIT = 100 * 1024 * 1024
 
 
-def choose_block_x(n: int, itemsize: int = 4) -> int:
+def choose_block_x(
+    n: int, itemsize: int = 4, field_itemsize: Optional[int] = None
+) -> int:
     """Largest power-of-two slab depth (<= 8) whose double-buffered pipeline
-    working set fits the VMEM budget (and divides N)."""
-    plane = n * n * itemsize
+    working set fits the VMEM budget (and divides N).
+
+    The bx-deep buffers in flight are u_prev + u + out (state `itemsize`
+    each) plus, for the variable-c kernel, the field slab at
+    `field_itemsize` - the COMPUTE dtype's width (f32), which differs from
+    the state width under bf16.  Getting the accounting wrong is a real
+    cliff, not a tweak: the var-c kernel at N=512 ran 2.7x slower with the
+    constant-kernel choice (bx=8, 68 MB pipeline) than with the correct
+    bx=4 (measured 8.1 vs 19.5 Gcell/s on v5e).
+    """
+    per_bx = 3 * itemsize + (field_itemsize or 0)   # bytes per plane, slabs
+    halo = 2 * itemsize                             # two 1-plane halos
     bx = 1
     while (
         bx < 8
         and n % (bx * 2) == 0
-        and 2 * (3 * (bx * 2) + 2) * plane <= _VMEM_BUDGET
+        and 2 * (per_bx * (bx * 2) + halo) * n * n <= _VMEM_BUDGET
     ):
         bx *= 2
     return bx
@@ -149,11 +162,14 @@ def _fused_step(u_prev, u, *, inv_h2, alpha=2.0, beta=1.0, coeff=None,
     kernels; `c2tau2_field` selects the variable kernel (its slab is
     prepended as an extra input)."""
     n = u.shape[0]
-    bx = block_x or choose_block_x(n, u.dtype.itemsize)
-    if n % bx:
-        raise ValueError(f"block_x={bx} must divide N={n}")
     if compute_dtype is None:
         compute_dtype = stencil_ref.compute_dtype(u.dtype)
+    field_itemsize = (
+        None if c2tau2_field is None else jnp.dtype(compute_dtype).itemsize
+    )
+    bx = block_x or choose_block_x(n, u.dtype.itemsize, field_itemsize)
+    if n % bx:
+        raise ValueError(f"block_x={bx} must divide N={n}")
     slab, lo, hi = _specs(n, bx)
     if c2tau2_field is None:
         kernel = functools.partial(
@@ -226,4 +242,4 @@ def make_step_fn(block_x=None, interpret=False, c2tau2_field=None):
             block_x=block_x, interpret=interpret,
         )
 
-    return ParamStep(var_step, jnp.asarray(c2tau2_field))
+    return ParamStep(var_step, ParamStep.materialize(c2tau2_field))

@@ -128,7 +128,7 @@ def make_variable_c_step(c2tau2_field):
         )
         return apply_dirichlet(u_next).astype(u.dtype)
 
-    return ParamStep(step, jnp.asarray(np.asarray(c2tau2_field)))
+    return ParamStep(step, ParamStep.materialize(np.asarray(c2tau2_field)))
 
 
 def laplacian_ext(ext, inv_h2):

@@ -74,6 +74,17 @@ class ParamStep(NamedTuple):
         """Direct use outside a solver (tests, one-off steps)."""
         return self.fn(u_prev, u, problem, self.params)
 
+    @staticmethod
+    def materialize(array):
+        """Convert a field to a device array and force the host->device
+        transfer NOW.  On remote backends the upload is lazy and would
+        otherwise land inside the first solve's timed region (a 512 MB
+        field costs ~10-20 s through this image's tunnel, tripling the
+        apparent solve time)."""
+        dev = jnp.asarray(array)
+        np.asarray(dev[:1, :1, :1] if dev.ndim == 3 else dev.ravel()[:1])
+        return dev
+
 
 def _as_param_step(step_fn):
     """Normalize the three accepted step_fn forms to (fn4, params)."""
@@ -169,14 +180,23 @@ def _scan_layers(
     return jax.lax.scan(body, (u_prev, u_cur), jnp.arange(start + 1, stop + 1))
 
 
-def _timed_compile_run(runner, example_args=()):
+def _timed_compile_run(runner, example_args=(), sync=None):
     """lower/compile then execute; returns (outputs, init_s, solve_s) with
-    the reference's two timing phases (mpi_new.cpp:472-474, 354-357)."""
+    the reference's two timing phases (mpi_new.cpp:472-474, 354-357).
+
+    `sync(out)` must force a (small) device-to-host transfer.  On remote
+    backends (this image's axon tunnel) `block_until_ready` can return
+    before execution for programs with runtime array arguments; only a
+    readback proves the program ran, so the transfer sits INSIDE the timed
+    region.  Keep it small (e.g. the per-layer error vector, not a field).
+    """
     t0 = time.perf_counter()
     lowered = runner.lower(*example_args).compile()
     t1 = time.perf_counter()
     out = lowered(*example_args)
     jax.block_until_ready(out)
+    if sync is not None:
+        sync(out)
     t2 = time.perf_counter()
     return out, t1 - t0, t2 - t1
 
@@ -264,7 +284,7 @@ def solve(
         problem, dtype, step_fn, compute_errors, stop_step
     )
     (u_prev, u_cur, abs_all, rel_all), init_s, solve_s = _timed_compile_run(
-        runner, (step_params,)
+        runner, (step_params,), sync=lambda out: np.asarray(out[2])
     )
     return SolveResult(
         problem=problem,
@@ -322,7 +342,7 @@ def resume(
 
     args = (jnp.asarray(u_prev, dtype), jnp.asarray(u_cur, dtype), step_params)
     (u_p, u_c, abs_all, rel_all), init_s, solve_s = _timed_compile_run(
-        jax.jit(run), args
+        jax.jit(run), args, sync=lambda out: np.asarray(out[2])
     )
     return SolveResult(
         problem=problem,

@@ -26,6 +26,7 @@ from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from wavetpu.comm import halo
@@ -53,7 +54,7 @@ def _probe_runner(problem: Problem, topo: Topology, mesh, dtype, with_halo,
     c_full = problem.a2tau2
     inv_h2 = problem.inv_h2
 
-    def local(u_prev, u):
+    def local(u_prev, u, salt):
         def body(carry, _):
             u_prev, u = carry
             if with_halo:
@@ -64,26 +65,38 @@ def _probe_runner(problem: Problem, topo: Topology, mesh, dtype, with_halo,
             u_next = 2.0 * u - u_prev + jnp.asarray(c_full, dtype) * lap
             return (u, u_next), None
 
-        (u_prev, u), _ = jax.lax.scan(body, (u_prev, u), None, length=iters)
-        return u_prev, u
+        (u_prev, u), _ = jax.lax.scan(
+            body, (u_prev + salt, u), None, length=iters
+        )
+        # Scalar checksum output: reading it back on the host both forces
+        # execution (remote backends can defer past block_until_ready) and
+        # keeps the transfer tiny.
+        return jax.lax.psum(jnp.sum(u), AXIS_NAMES)
 
     spec = P(*AXIS_NAMES)
     return jax.jit(
         jax.shard_map(
-            local, mesh=mesh, in_specs=(spec, spec), out_specs=(spec, spec)
+            local,
+            mesh=mesh,
+            in_specs=(spec, spec, P()),
+            out_specs=P(),
         )
     )
 
 
 def _time_best(fn, args, repeats: int) -> float:
-    """Best-of-N wall time of the compiled callable (compile excluded)."""
-    out = fn(*args)  # compile + warm up
-    jax.block_until_ready(out)
+    """Best-of-N wall time of the compiled callable (compile excluded).
+
+    Each call gets a distinct `salt` input so remote backends cannot serve
+    a memoized result, and the scalar output is read back to force
+    completion.
+    """
+    np.asarray(fn(*args, jnp.zeros((), args[0].dtype)))  # compile + warm up
     best = float("inf")
-    for _ in range(repeats):
+    for i in range(repeats):
+        salt = jnp.asarray(1e-6 * (i + 1), args[0].dtype)
         t0 = time.perf_counter()
-        out = fn(*args)
-        jax.block_until_ready(out)
+        np.asarray(fn(*args, salt))
         best = min(best, time.perf_counter() - t0)
     return best
 
