@@ -37,6 +37,35 @@ def test_checkpoint_roundtrip(small_problem, tmp_path):
     np.testing.assert_array_equal(u_prev, np.asarray(half.u_prev))
 
 
+def test_bf16_checkpoint_roundtrip_bitwise(small_problem, tmp_path):
+    """bf16 state survives save/load bitwise (np.savez would otherwise store
+    ml_dtypes bfloat16 as void |V2 and resume would die with a TypeError -
+    the round-2/3 advisor finding)."""
+    import jax.numpy as jnp
+
+    half = leapfrog.solve(small_problem, dtype=jnp.bfloat16, stop_step=5)
+    path = checkpoint.save_checkpoint(str(tmp_path / "bf16.npz"), half)
+    problem, u_prev, u_cur, step = checkpoint.load_checkpoint(path)
+    assert u_cur.dtype.name == "bfloat16"
+    assert u_prev.dtype.name == "bfloat16"
+    np.testing.assert_array_equal(
+        u_cur.view(np.uint16), np.asarray(half.u_cur).view(np.uint16)
+    )
+    np.testing.assert_array_equal(
+        u_prev.view(np.uint16), np.asarray(half.u_prev).view(np.uint16)
+    )
+
+    # And the advertised preemption workflow runs clean end to end: the
+    # resumed run equals the uninterrupted bf16 run bitwise.
+    full = leapfrog.solve(small_problem, dtype=jnp.bfloat16)
+    resumed = checkpoint.resume_solve(path)
+    assert np.asarray(resumed.u_cur).dtype.name == "bfloat16"
+    np.testing.assert_array_equal(
+        np.asarray(resumed.u_cur).view(np.uint16),
+        np.asarray(full.u_cur).view(np.uint16),
+    )
+
+
 def test_resume_from_final_state_is_noop(small_problem, tmp_path):
     full = leapfrog.solve(small_problem)
     path = checkpoint.save_checkpoint(str(tmp_path / "ck.npz"), full)

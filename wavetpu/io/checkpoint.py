@@ -28,6 +28,39 @@ from wavetpu.solver.leapfrog import SolveResult
 _FORMAT_VERSION = 1
 
 
+def _encode_field(arr) -> Tuple[np.ndarray, str]:
+    """(storable array, dtype tag) for one state field.
+
+    `np.savez` silently stores ml_dtypes' bfloat16 as raw void bytes (|V2)
+    that `jnp.asarray` then rejects on load, so bf16 travels as a uint16
+    bit-view plus a dtype tag and is re-viewed on the way back - the
+    round-trip is bitwise (the invariant tests/test_checkpoint.py pins).
+    Native numpy dtypes pass through untouched.
+    """
+    arr = np.asarray(arr)
+    if arr.dtype.name == "bfloat16":
+        return arr.view(np.uint16), "bfloat16"
+    if arr.dtype.kind == "V":
+        # Some other ml_dtypes custom dtype (fp8, ...): a uint16 view would
+        # silently reshape/corrupt it, and np.savez would store raw void
+        # bytes - refuse at save time instead.
+        raise ValueError(
+            f"cannot checkpoint dtype {arr.dtype.name}: only native numpy "
+            f"dtypes and bfloat16 are supported"
+        )
+    return arr, arr.dtype.name
+
+
+def _decode_field(arr: np.ndarray, tag: Optional[str]) -> np.ndarray:
+    """Inverse of `_encode_field`; also recovers legacy untagged checkpoints
+    whose bf16 fields were stored as void |V2 (same raw bytes)."""
+    if tag == "bfloat16" or (tag is None and arr.dtype.kind == "V"):
+        import ml_dtypes
+
+        return arr.view(np.uint16).view(ml_dtypes.bfloat16)
+    return arr
+
+
 def save_checkpoint(path: str, result: SolveResult) -> str:
     """Write (u_prev, u_cur, step, problem) from a (possibly partial) solve.
 
@@ -38,18 +71,34 @@ def save_checkpoint(path: str, result: SolveResult) -> str:
     step = (
         result.final_step if result.final_step is not None else p.timesteps
     )
+    u_prev, prev_tag = _encode_field(result.u_prev)
+    u_cur, cur_tag = _encode_field(result.u_cur)
     np.savez(
         path,
         format_version=_FORMAT_VERSION,
         step=step,
-        u_prev=np.asarray(result.u_prev),
-        u_cur=np.asarray(result.u_cur),
+        u_prev=u_prev,
+        u_cur=u_cur,
+        u_prev_dtype=prev_tag,
+        u_cur_dtype=cur_tag,
         **{
             f"problem_{k}": v
             for k, v in dataclasses.asdict(p).items()
         },
     )
     return path if path.endswith(".npz") else path + ".npz"
+
+
+def _problem_from_npz(z) -> Problem:
+    return Problem(
+        N=int(z["problem_N"]),
+        Np=int(z["problem_Np"]),
+        Lx=float(z["problem_Lx"]),
+        Ly=float(z["problem_Ly"]),
+        Lz=float(z["problem_Lz"]),
+        T=float(z["problem_T"]),
+        timesteps=int(z["problem_timesteps"]),
+    )
 
 
 def load_checkpoint(path: str) -> Tuple[Problem, np.ndarray, np.ndarray, int]:
@@ -60,16 +109,14 @@ def load_checkpoint(path: str) -> Tuple[Problem, np.ndarray, np.ndarray, int]:
             raise ValueError(
                 f"checkpoint format {version} != supported {_FORMAT_VERSION}"
             )
-        problem = Problem(
-            N=int(z["problem_N"]),
-            Np=int(z["problem_Np"]),
-            Lx=float(z["problem_Lx"]),
-            Ly=float(z["problem_Ly"]),
-            Lz=float(z["problem_Lz"]),
-            T=float(z["problem_T"]),
-            timesteps=int(z["problem_timesteps"]),
-        )
-        return problem, z["u_prev"], z["u_cur"], int(z["step"])
+        problem = _problem_from_npz(z)
+
+        def tag(name):
+            return str(z[name]) if name in z.files else None
+
+        u_prev = _decode_field(z["u_prev"], tag("u_prev_dtype"))
+        u_cur = _decode_field(z["u_cur"], tag("u_cur_dtype"))
+        return problem, u_prev, u_cur, int(z["step"])
 
 
 def resume_solve(
