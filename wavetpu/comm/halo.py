@@ -50,20 +50,32 @@ def _place(ext, ghost, axis: int, pos):
     return lax.dynamic_update_slice(ext, ghost, starts)
 
 
-def halo_extend(u: jax.Array, topo: Topology) -> jax.Array:
-    """Exchange 6 face ghosts and return the (bx+2, by+2, bz+2) extension.
+def collect_ghosts(u: jax.Array, topo: Topology):
+    """Exchange the 6 face ghost planes; no placement.
 
-    Must run inside `shard_map` over the (x, y, z) mesh.  Replaces
-    `exchange(n)` + ghost-plane unpack of the reference (mpi_new.cpp:181-269);
-    `kernels.stencil_ref.laplacian_ext` consumes the result.
+    Must run inside `shard_map` over the (x, y, z) mesh.  Returns
+    ((xlo, xhi), (ylo, yhi), (zlo, zhi)) where `lo` is this shard's lower
+    ghost (the -1 neighbour of its plane 0) and `hi` its upper ghost (the
+    +1 neighbour of its last *real* plane).  The ppermute side of the
+    reference's `exchange(n)` (mpi_new.cpp:181-269); placement into an
+    extended array (`halo_extend`) or into the Pallas kernel's operand
+    slots (`solver.sharded`) is the caller's choice.
     """
-    ext = jnp.pad(u, 1)
+    ghosts = []
     for axis, name in enumerate(AXIS_NAMES):
         m = topo.mesh_shape[axis]
         b = topo.block[axis]
         r = topo.r_last[axis]
-        idx = lax.axis_index(name)
-        is_last = idx == m - 1
+        if m == 1:
+            # Single shard on this axis: the "exchange" is the local cyclic
+            # wrap (a ppermute would be a self-copy; skipping it statically
+            # removes real HBM traffic on every 1-dim mesh axis).  No pad
+            # exists when m == 1, so b == r.
+            ghost_lo = lax.slice_in_dim(u, b - 1, b, axis=axis)
+            ghost_hi = lax.slice_in_dim(u, 0, 1, axis=axis)
+            ghosts.append((ghost_lo, ghost_hi))
+            continue
+        is_last = lax.axis_index(name) == m - 1
         # Forward: my last real plane becomes the next shard's lower ghost.
         send_fwd = lax.dynamic_slice_in_dim(
             u, jnp.where(is_last, r - 1, b - 1), 1, axis
@@ -72,6 +84,56 @@ def halo_extend(u: jax.Array, topo: Topology) -> jax.Array:
         # Backward: my first plane becomes the previous shard's upper ghost.
         send_bwd = lax.slice_in_dim(u, 0, 1, axis=axis)
         ghost_hi = lax.ppermute(send_bwd, name, _bwd_perm(m))
+        ghosts.append((ghost_lo, ghost_hi))
+    return tuple(ghosts)
+
+
+def place_ghosts(u: jax.Array, ghosts, topo: Topology) -> jax.Array:
+    """Build the (bx+2, by+2, bz+2) extension from pre-exchanged ghosts."""
+    ext = jnp.pad(u, 1)
+    for axis, (ghost_lo, ghost_hi) in enumerate(ghosts):
+        m = topo.mesh_shape[axis]
+        b = topo.block[axis]
+        r = topo.r_last[axis]
+        is_last = lax.axis_index(AXIS_NAMES[axis]) == m - 1
         ext = _place(ext, ghost_lo, axis, 0)
         ext = _place(ext, ghost_hi, axis, jnp.where(is_last, r + 1, b + 1))
     return ext
+
+
+def halo_extend(u: jax.Array, topo: Topology) -> jax.Array:
+    """Exchange 6 face ghosts and return the (bx+2, by+2, bz+2) extension.
+
+    Must run inside `shard_map` over the (x, y, z) mesh.  Replaces
+    `exchange(n)` + ghost-plane unpack of the reference (mpi_new.cpp:181-269);
+    `kernels.stencil_ref.laplacian_ext` consumes the result.
+    """
+    return place_ghosts(u, collect_ghosts(u, topo), topo)
+
+
+def absorb_hi_ghosts(u: jax.Array, ghosts, topo: Topology) -> jax.Array:
+    """Write each axis's `hi` ghost into the first pad plane of `u` on the
+    last shard of that axis (uneven shards only).
+
+    The Pallas sharded kernel reads the +1 neighbour of local plane p from
+    plane p+1 of its operand block, so for an unevenly sharded axis (where
+    the last shard's last real plane r-1 is followed by pad, not by the
+    ghost) the ghost must live *inside* the block at plane r - the in-block
+    counterpart of `place_ghosts` writing ext position r+1.  Axes that
+    divide evenly are untouched (their hi ghost rides the kernel's explicit
+    ghost operand instead).  Pad planes of the *output* are re-zeroed by the
+    kernel's global mask, so the invariant "carry state has zero pad" holds.
+    """
+    for axis, (_, ghost_hi) in enumerate(ghosts):
+        b = topo.block[axis]
+        r = topo.r_last[axis]
+        if r == b:
+            continue  # even split: no pad plane on this axis
+        m = topo.mesh_shape[axis]
+        is_last = lax.axis_index(AXIS_NAMES[axis]) == m - 1
+        # Non-last shards overwrite their (real) plane r with itself.
+        own = lax.slice_in_dim(u, r, r + 1, axis=axis)
+        plane = jnp.where(is_last, ghost_hi, own)
+        starts = [r if a == axis else 0 for a in range(3)]
+        u = lax.dynamic_update_slice(u, plane, starts)
+    return u

@@ -56,11 +56,14 @@ _VMEM_BUDGET = 56 * 1024 * 1024
 _VMEM_LIMIT = 100 * 1024 * 1024
 
 
-def choose_block_x(
-    n: int, itemsize: int = 4, field_itemsize: Optional[int] = None
+def _choose_block_depth(
+    depth: int,
+    plane_elems: int,
+    itemsize: int = 4,
+    field_itemsize: Optional[int] = None,
 ) -> int:
     """Largest power-of-two slab depth (<= 8) whose double-buffered pipeline
-    working set fits the VMEM budget (and divides N).
+    working set fits the VMEM budget (and divides `depth`).
 
     The bx-deep buffers in flight are u_prev + u + out (state `itemsize`
     each) plus, for the variable-c kernel, the field slab at
@@ -69,17 +72,28 @@ def choose_block_x(
     cliff, not a tweak: the var-c kernel at N=512 ran 2.7x slower with the
     constant-kernel choice (bx=8, 68 MB pipeline) than with the correct
     bx=4 (measured 8.1 vs 19.5 Gcell/s on v5e).
+
+    `plane_elems` is the (y, z) plane size in elements - n*n for the full
+    fundamental domain, by*bz for a shard block.
     """
     per_bx = 3 * itemsize + (field_itemsize or 0)   # bytes per plane, slabs
     halo = 2 * itemsize                             # two 1-plane halos
     bx = 1
     while (
         bx < 8
-        and n % (bx * 2) == 0
-        and 2 * (per_bx * (bx * 2) + halo) * n * n <= _VMEM_BUDGET
+        and depth % (bx * 2) == 0
+        and 2 * (per_bx * (bx * 2) + halo) * plane_elems <= _VMEM_BUDGET
     ):
         bx *= 2
     return bx
+
+
+def choose_block_x(
+    n: int, itemsize: int = 4, field_itemsize: Optional[int] = None
+) -> int:
+    """Slab depth for the single-device (N, N, N) kernels (see
+    `_choose_block_depth`)."""
+    return _choose_block_depth(n, n * n, itemsize, field_itemsize)
 
 
 def _slab_laplacian(c, ulo_ref, uhi_ref, inv_h2, f):
@@ -216,6 +230,191 @@ def taylor_half_step(u0, problem: Problem, *, block_x=None, interpret=False):
         u0, u0, alpha=1.0, beta=0.0, coeff=0.5 * problem.a2tau2,
         inv_h2=problem.inv_h2, block_x=block_x, interpret=interpret,
     )
+
+
+def _sharded_kernel(*refs, alpha, beta, coeff, has_field, need, pad,
+                    n_global, block_x, inv_h2, compute_dtype):
+    """Per-shard fused update slab - the distributed counterpart of
+    `_step_kernel`, the analog of the reference's per-rank CUDA kernel
+    launch (cuda_sol.cpp:381-443 driving calculate_layer,
+    cuda_sol_kernels.cu:24-47).
+
+    Statically specialized per axis on the mesh shape:
+
+     * `need[a]` (mesh dim > 1): the axis's shard-boundary neighbours come
+       from ppermute'd ghost operands - the x halo overrides the wraparound
+       BlockSpec planes at the grid edges, y/z ghosts override the wrapped
+       row/lane of the in-VMEM roll via an iota select.  On a 1-shard axis
+       the in-shard wrap IS the global neighbour (periodic x / stored zero
+       Dirichlet plane in y/z), so no ghost operands and no selects exist
+       at all - a (1,1,1) mesh compiles to the single-device kernel's data
+       path.
+     * `pad[a]` (uneven shards): the global-index < N mask component only
+       exists on axes that actually carry pad planes.
+
+    The y/z Dirichlet zeroing (global index != 0) is always applied, from
+    the shard offsets in SMEM - the generalization of `_finish_update`'s
+    local y=0/z=0 masking to arbitrary shard position.  All masking stays
+    fused in the store: no HBM traffic.
+    """
+    f = compute_dtype
+    it = iter(refs[:-1])
+    out_ref = refs[-1]
+    off_ref = next(it)
+    c2_ref = next(it) if has_field else None
+    uprev_ref = next(it)
+    uc_ref = next(it)
+    ulo_ref = next(it)
+    uhi_ref = next(it)
+    xlo_ref = next(it) if need[0] else None
+    xhi_ref = next(it) if need[0] else None
+    ylo_ref = next(it) if need[1] else None
+    yhi_ref = next(it) if need[1] else None
+    zlo_ref = next(it) if need[2] else None
+    zhi_ref = next(it) if need[2] else None
+
+    c = uc_ref[:].astype(f)
+    shape = c.shape
+    ix, iy, iz = (jnp.asarray(v, f) for v in inv_h2)
+    i = pl.program_id(0)
+
+    # x neighbours: slab halo planes, ghost-overridden at the grid edges.
+    lo = ulo_ref[:].astype(f)
+    hi = uhi_ref[:].astype(f)
+    if need[0]:
+        last = pl.num_programs(0) - 1
+        lo = jnp.where(i == 0, xlo_ref[:].astype(f), lo)
+        hi = jnp.where(i == last, xhi_ref[:].astype(f), hi)
+    ext = jnp.concatenate([lo, c, hi], 0)
+    lap = (ext[:-2] + ext[2:] - 2.0 * c) * ix
+
+    # y/z neighbours: in-VMEM cyclic rolls (pltpu.roll wants non-negative
+    # shifts: roll by size-1 == roll by -1), ghost-overridden at the wrap.
+    ny, nz = shape[1], shape[2]
+    dn, up = pltpu.roll(c, 1, 1), pltpu.roll(c, ny - 1, 1)
+    if need[1]:
+        iota_y = lax.broadcasted_iota(jnp.int32, shape, 1)
+        dn = jnp.where(iota_y == 0, ylo_ref[:].astype(f), dn)
+        up = jnp.where(iota_y == ny - 1, yhi_ref[:].astype(f), up)
+    lap = lap + (dn + up - 2.0 * c) * iy
+    dn, up = pltpu.roll(c, 1, 2), pltpu.roll(c, nz - 1, 2)
+    if need[2]:
+        iota_z = lax.broadcasted_iota(jnp.int32, shape, 2)
+        dn = jnp.where(iota_z == 0, zlo_ref[:].astype(f), dn)
+        up = jnp.where(iota_z == nz - 1, zhi_ref[:].astype(f), up)
+    lap = lap + (dn + up - 2.0 * c) * iz
+
+    if has_field:
+        u_next = jnp.asarray(alpha, f) * c + c2_ref[:].astype(f) * lap
+    else:
+        u_next = jnp.asarray(alpha, f) * c + jnp.asarray(coeff, f) * lap
+    if beta:
+        u_next = u_next - jnp.asarray(beta, f) * uprev_ref[:].astype(f)
+
+    # Fused boundary/pad mask (reference: the whole prepare_layer pass,
+    # openmp_sol.cpp:104-112, plus pad-cell re-zeroing).
+    gy = off_ref[1] + lax.broadcasted_iota(jnp.int32, shape, 1)
+    gz = off_ref[2] + lax.broadcasted_iota(jnp.int32, shape, 2)
+    mask = (gy != 0) & (gz != 0)
+    if pad[0]:
+        gx = (
+            off_ref[0] + i * block_x
+            + lax.broadcasted_iota(jnp.int32, shape, 0)
+        )
+        mask &= gx < n_global
+    if pad[1]:
+        mask &= gy < n_global
+    if pad[2]:
+        mask &= gz < n_global
+    out_ref[:] = jnp.where(mask, u_next, jnp.asarray(0.0, f)).astype(
+        out_ref.dtype
+    )
+
+
+def sharded_fused_step(u_prev, u, ghosts, offsets, n_global, *, inv_h2,
+                       mesh_shape, r_last=None,
+                       alpha=2.0, beta=1.0, coeff=None, c2tau2_block=None,
+                       block_x=None, interpret=False, compute_dtype=None):
+    """One fused leapfrog-form update of a shard block with pre-exchanged
+    ghosts - the Pallas hot kernel of the distributed solver.
+
+    Must run inside `shard_map`.  `ghosts` is `comm.halo.collect_ghosts`
+    output ((xlo, xhi), (ylo, yhi), (zlo, zhi)); for an unevenly sharded
+    axis the hi ghost must additionally be absorbed into the block first
+    (`comm.halo.absorb_hi_ghosts`).  `offsets` is an int32 (3,) array of
+    the shard's global cell offsets; `n_global` the fundamental N.
+    `mesh_shape` / `r_last` drive the static per-axis specialization (see
+    `_sharded_kernel`).  With `c2tau2_block` (this shard's slice of the
+    tau^2 c^2 field) the variable-speed kernel runs and `coeff` is ignored.
+    """
+    bx_tot, by, bz = u.shape
+    if compute_dtype is None:
+        compute_dtype = stencil_ref.compute_dtype(u.dtype)
+    has_field = c2tau2_block is not None
+    field_itemsize = (
+        None if not has_field else jnp.dtype(compute_dtype).itemsize
+    )
+    bx = block_x or _choose_block_depth(
+        bx_tot, by * bz, u.dtype.itemsize, field_itemsize
+    )
+    if bx_tot % bx:
+        raise ValueError(f"block_x={bx} must divide shard depth {bx_tot}")
+    need = tuple(m > 1 for m in mesh_shape)
+    if r_last is None:
+        pads = (False, False, False)
+    else:
+        pads = tuple(r != b for r, b in zip(r_last, u.shape))
+
+    slab = pl.BlockSpec((bx, by, bz), lambda i: (i, 0, 0),
+                        memory_space=pltpu.VMEM)
+    lo = pl.BlockSpec((1, by, bz), lambda i: ((i * bx - 1) % bx_tot, 0, 0),
+                      memory_space=pltpu.VMEM)
+    hi = pl.BlockSpec((1, by, bz),
+                      lambda i: (((i + 1) * bx) % bx_tot, 0, 0),
+                      memory_space=pltpu.VMEM)
+    gx = pl.BlockSpec((1, by, bz), lambda i: (0, 0, 0),
+                      memory_space=pltpu.VMEM)
+    gy = pl.BlockSpec((bx, 1, bz), lambda i: (i, 0, 0),
+                      memory_space=pltpu.VMEM)
+    gz = pl.BlockSpec((bx, by, 1), lambda i: (i, 0, 0),
+                      memory_space=pltpu.VMEM)
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+
+    (xg, yg, zg) = ghosts
+    in_specs = [smem]
+    operands = [jnp.asarray(offsets, jnp.int32)]
+    if has_field:
+        in_specs.append(slab)
+        operands.append(jnp.asarray(c2tau2_block, dtype=compute_dtype))
+    in_specs += [slab, slab, lo, hi]
+    operands += [u_prev, u, u, u]
+    for needed, spec, (g_lo, g_hi) in zip(need, (gx, gy, gz), (xg, yg, zg)):
+        if needed:
+            in_specs += [spec, spec]
+            operands += [g_lo, g_hi]
+
+    kernel = functools.partial(
+        _sharded_kernel,
+        alpha=alpha, beta=beta, coeff=coeff, has_field=has_field,
+        need=need, pad=pads, n_global=n_global, block_x=bx,
+        inv_h2=inv_h2, compute_dtype=compute_dtype,
+    )
+    # Under shard_map with check_vma the output aval must declare which mesh
+    # axes it varies over - same as the input state it replaces.
+    vma = getattr(getattr(u, "aval", None), "vma", None)
+    if vma:
+        out_shape = jax.ShapeDtypeStruct(u.shape, u.dtype, vma=vma)
+    else:
+        out_shape = jax.ShapeDtypeStruct(u.shape, u.dtype)
+    return pl.pallas_call(
+        kernel,
+        grid=(bx_tot // bx,),
+        in_specs=in_specs,
+        out_specs=slab,
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        interpret=interpret,
+    )(*operands)
 
 
 def make_step_fn(block_x=None, interpret=False, c2tau2_field=None):
