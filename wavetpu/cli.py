@@ -22,13 +22,27 @@ pick at runtime):
                                     (probe programs; see solver/timing.py) and
                                     add it to the report, like the reference's
                                     "new" variants (mpi_new.cpp:368-371)
+  --kernel {auto,roll,pallas}       hot-kernel selection: pallas = the fused
+                                    slab kernel (kernels/stencil_pallas.py,
+                                    the analog of the reference shipping its
+                                    CUDA kernel in every binary,
+                                    Makefile:4-8); roll = the XLA reference
+                                    stencil; auto = pallas on TPU, roll
+                                    elsewhere (off-TPU pallas runs in
+                                    interpret mode - correct but slow)
+  --overlap                         overlap halo exchange with the bulk
+                                    stencil update (sharded backend, even
+                                    shard splits only)
   --stop-step S                     halt after layer S (tau unchanged); pairs
                                     with --save-state for preemptible runs
   --save-state PATH                 write the final (u_prev, u_cur, step)
-                                    checkpoint (io/checkpoint.py)
+                                    checkpoint: one .npz (single backend) or
+                                    a per-shard directory (sharded backend)
+                                    (io/checkpoint.py)
   --resume PATH                     continue a checkpointed run to its
                                     timesteps (positionals then unnecessary);
-                                    single-device backend only
+                                    a directory resumes on the sharded
+                                    backend, a .npz on the single-device one
 """
 
 from __future__ import annotations
@@ -42,8 +56,23 @@ from wavetpu.core.problem import Problem
 _KNOWN_FLAGS = (
     "backend", "mesh", "dtype", "no-errors", "out-dir", "platform",
     "phase-timing", "stop-step", "save-state", "resume",
+    "kernel", "overlap",
 )
-_VALUELESS = ("no-errors", "phase-timing")
+_VALUELESS = ("no-errors", "phase-timing", "overlap")
+
+
+def resolve_kernel(flag_value: str, platform: str) -> str:
+    """Map --kernel {auto,roll,pallas} to the concrete kernel for
+    `platform` (jax.default_backend()).  auto = pallas only where Mosaic
+    compiles it natively; everywhere else the roll stencil is the fast
+    path and interpret-mode pallas is opt-in."""
+    if flag_value not in ("auto", "roll", "pallas"):
+        raise ValueError(
+            f"--kernel must be auto|roll|pallas, got {flag_value}"
+        )
+    if flag_value == "auto":
+        return "pallas" if platform == "tpu" else "roll"
+    return flag_value
 
 
 def _split_flags(argv: Sequence[str]) -> Tuple[List[str], dict]:
@@ -80,11 +109,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         pos, flags = _split_flags(argv)
         if flags.get("dtype", "f32") not in ("f32", "f64", "bf16"):
             raise ValueError(f"--dtype must be f32|f64|bf16, got {flags['dtype']}")
+        if flags.get("kernel", "auto") not in ("auto", "roll", "pallas"):
+            raise ValueError(
+                f"--kernel must be auto|roll|pallas, got {flags['kernel']}"
+            )
         if flags.get("backend") == "single" and "mesh" in flags:
             raise ValueError("--mesh contradicts --backend single")
+        if flags.get("backend") == "single" and "overlap" in flags:
+            raise ValueError("--overlap applies to the sharded backend")
         if "resume" in flags:
-            if flags.get("backend") == "sharded" or "mesh" in flags:
-                raise ValueError("--resume supports the single backend only")
             if "stop-step" in flags:
                 raise ValueError("--resume and --stop-step are exclusive")
             problem = None  # comes from the checkpoint
@@ -103,27 +136,61 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(
             "usage: wavetpu N Np Lx Ly Lz [T] [timesteps] "
             "[--backend auto|single|sharded] [--mesh MX,MY,MZ] "
-            "[--dtype f32|f64|bf16] [--no-errors] [--out-dir DIR] "
-            "[--platform NAME]",
+            "[--dtype f32|f64|bf16] [--kernel auto|roll|pallas] "
+            "[--overlap] [--no-errors] [--out-dir DIR] [--platform NAME]",
             file=sys.stderr,
         )
         return 2
 
     resume_state = None
+    resume_is_sharded = False
     if "resume" in flags:
+        import os as _os
+
         from wavetpu.io import checkpoint as _ckpt
 
+        resume_is_sharded = _os.path.isdir(flags["resume"])
         try:
-            problem, _u_prev0, _u_cur0, _start = _ckpt.load_checkpoint(
-                flags["resume"]
-            )
+            if resume_is_sharded:
+                if flags.get("backend") == "single":
+                    print(
+                        "error: checkpoint is a per-shard directory; "
+                        "--backend single cannot resume it",
+                        file=sys.stderr,
+                    )
+                    return 2
+                # Meta only (numpy): the shard arrays are loaded after the
+                # jax platform is configured below.
+                problem, _start, _ck_mesh, _ck_dtype = (
+                    _ckpt.load_sharded_meta(flags["resume"])
+                )
+                if "mesh" in flags and tuple(
+                    int(x) for x in flags["mesh"].split(",")
+                ) != _ck_mesh:
+                    print(
+                        f"error: --mesh contradicts the checkpoint's mesh "
+                        f"{_ck_mesh}",
+                        file=sys.stderr,
+                    )
+                    return 2
+            else:
+                if flags.get("backend") == "sharded" or "mesh" in flags:
+                    print(
+                        "error: checkpoint is a single-device .npz; "
+                        "--backend sharded/--mesh cannot resume it",
+                        file=sys.stderr,
+                    )
+                    return 2
+                problem, _u_prev0, _u_cur0, _start = _ckpt.load_checkpoint(
+                    flags["resume"]
+                )
+                resume_state = (_u_prev0, _u_cur0, _start)
         except Exception as e:
             # OSError, KeyError, ValueError, zipfile.BadZipFile (truncated
             # .npz from a mid-save preemption - the exact case --resume
             # exists for), ... all mean the same thing to the user.
             print(f"error: cannot load checkpoint: {e}", file=sys.stderr)
             return 2
-        resume_state = (_u_prev0, _u_cur0, _start)
 
     # Courant printout before solving (openmp_sol.cpp:214, mpi_new.cpp:404).
     print(f"C = {problem.courant:.6g}")
@@ -147,7 +214,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "f64": jnp.float64,
         "bf16": jnp.bfloat16,
     }[flags.get("dtype", "f32")]
-    if dtype == jnp.float64:
+    resume_dtype_name = None
+    if resume_state is not None:
+        resume_dtype_name = resume_state[1].dtype.name
+    elif resume_is_sharded:
+        resume_dtype_name = _ck_dtype
+    if dtype == jnp.float64 or (
+        "dtype" not in flags and resume_dtype_name == "float64"
+    ):
+        # Without x64, device_put would silently canonicalize a checkpointed
+        # f64 state to f32 and break the bitwise-equal-resume guarantee.
         jax.config.update("jax_enable_x64", True)
     compute_errors = "no-errors" not in flags
     out_dir = flags.get("out-dir", ".")
@@ -161,39 +237,77 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print("error: --mesh wants MX,MY,MZ", file=sys.stderr)
             return 2
         backend = "sharded"
+    elif resume_is_sharded:
+        backend = "sharded"
     elif resume_state is not None:
         backend = "single"
     elif backend == "auto":
         backend = "sharded" if n_devices > 1 else "single"
-    if backend == "sharded" and (
-        "save-state" in flags or "stop-step" in flags
-    ):
-        # Checked after backend resolution so `--backend auto` on a
-        # multi-device host cannot silently run a full sharded solve where
-        # a partial single-device one was requested.
-        print(
-            "error: --save-state/--stop-step support the single backend only",
-            file=sys.stderr,
-        )
-        return 2
+
+    kernel = resolve_kernel(
+        flags.get("kernel", "auto"), jax.default_backend()
+    )
+    print(f"kernel: {kernel}")
+    overlap = "overlap" in flags
 
     if backend == "sharded":
         from wavetpu.solver import sharded
 
-        result = sharded.solve_sharded(
-            problem,
-            mesh_shape=mesh_shape,
-            dtype=dtype,
-            compute_errors=compute_errors,
-        )
-        from wavetpu.core.grid import choose_mesh_shape
+        if resume_is_sharded:
+            from wavetpu.io import checkpoint as _ckpt
 
-        shape = mesh_shape or choose_mesh_shape(n_devices)
+            try:
+                problem, _u_prev0, _u_cur0, _start, _ck_mesh = (
+                    _ckpt.load_sharded_checkpoint(flags["resume"])
+                )
+            except Exception as e:
+                # Missing/truncated shard files, step/meta mismatch from a
+                # mid-save preemption, or too few devices for the stored
+                # mesh - same clean exit as a corrupt .npz.
+                print(
+                    f"error: cannot load checkpoint: {e}", file=sys.stderr
+                )
+                return 2
+            resume_dtype = (
+                dtype if "dtype" in flags else jnp.dtype(_u_cur0.dtype)
+            )
+            result = sharded.resume_sharded(
+                problem,
+                _u_prev0,
+                _u_cur0,
+                start_step=_start,
+                mesh_shape=_ck_mesh,
+                dtype=resume_dtype,
+                kernel=kernel,
+                overlap=overlap,
+                compute_errors=compute_errors,
+            )
+            shape = _ck_mesh
+        else:
+            result = sharded.solve_sharded(
+                problem,
+                mesh_shape=mesh_shape,
+                dtype=dtype,
+                compute_errors=compute_errors,
+                kernel=kernel,
+                overlap=overlap,
+                stop_step=stop_step,
+            )
+            from wavetpu.core.grid import choose_mesh_shape
+
+            shape = mesh_shape or choose_mesh_shape(n_devices)
         n_procs = shape[0] * shape[1] * shape[2]
         variant = "TPU"
     else:
         from wavetpu.solver import leapfrog
 
+        step_fn = None
+        if kernel == "pallas":
+            from wavetpu.kernels import stencil_pallas
+
+            step_fn = stencil_pallas.make_step_fn(
+                interpret=jax.default_backend() != "tpu"
+            )
         if resume_state is not None:
             u_prev0, u_cur0, start = resume_state
             # Unless --dtype was given explicitly, resume in the dtype the
@@ -208,12 +322,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 u_cur0,
                 start_step=start,
                 dtype=resume_dtype,
+                step_fn=step_fn,
                 compute_errors=compute_errors,
             )
         else:
             result = leapfrog.solve(
                 problem,
                 dtype=dtype,
+                step_fn=step_fn,
                 compute_errors=compute_errors,
                 stop_step=stop_step,
             )
@@ -223,7 +339,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if "save-state" in flags:
         from wavetpu.io import checkpoint as _ckpt
 
-        ck_path = _ckpt.save_checkpoint(flags["save-state"], result)
+        if backend == "sharded":
+            ck_path = _ckpt.save_sharded_checkpoint(
+                flags["save-state"], result
+            )
+        else:
+            ck_path = _ckpt.save_checkpoint(flags["save-state"], result)
         print(f"checkpoint: {ck_path}")
 
     exchange_seconds = loop_seconds = None
