@@ -1,8 +1,16 @@
 """Driver benchmark: one JSON line on stdout, run on the real TPU chip.
 
 Headline config follows BASELINE.md's primary metric: N=512, 1000 steps,
-f32 state, fused analytic-error oracle ON (the reference always self-
-validates, mpi_new.cpp:340-344, so the honest number includes it).
+f32 state, fused Pallas kernel, fused analytic-error oracle ON (the
+reference always self-validates, mpi_new.cpp:340-344, so the honest number
+includes it).
+
+The single line also carries `sub_benchmarks` so every README claim is
+driver-captured (round-3 verdict, item 9): the bf16-state kernel, the
+jnp-roll XLA path, the sharded backend running the Pallas kernel through
+ppermute'd halos (mesh (1,1,1) on this one-chip image), and the
+compensated-scheme accuracy run (whose max_abs_error is the BASELINE
+accuracy gate: ~4e-6 discretization bound at this config).
 
 Throughput definition (pinned; ADVICE r1): cell updates per step are
 (N+1)^3 - the reference's grid-point count - times `timesteps` steps,
@@ -17,12 +25,30 @@ import sys
 BASELINE_GCELLS = 6.1  # r1 judge measurement, single v5e chip, jnp-roll f32
 
 
+def _run(tag, fn):
+    """Execute one benchmark config; failures are recorded, not fatal."""
+    import traceback
+
+    try:
+        res = fn()
+        return {
+            "gcells_per_s": round(res.gcells_per_second, 3),
+            "max_abs_error": float(res.abs_errors.max()),
+            "solve_seconds": round(res.solve_seconds, 3),
+        }
+    except Exception:
+        print(f"sub-benchmark {tag} failed:", file=sys.stderr)
+        traceback.print_exc()
+        return {"error": "failed; see stderr"}
+
+
 def main() -> int:
     import jax
+    import jax.numpy as jnp
 
     from wavetpu.core.problem import Problem
     from wavetpu.kernels import stencil_pallas
-    from wavetpu.solver import leapfrog
+    from wavetpu.solver import leapfrog, sharded
 
     dev = jax.devices()[0]
     n = 512
@@ -43,6 +69,36 @@ def main() -> int:
         traceback.print_exc()
         backend = "jnp-roll"
         res = leapfrog.solve(problem)
+
+    on_tpu = jax.default_backend() == "tpu"
+    subs = {
+        "bf16_pallas": _run(
+            "bf16_pallas",
+            lambda: leapfrog.solve(
+                problem,
+                dtype=jnp.bfloat16,
+                step_fn=stencil_pallas.make_step_fn(interpret=not on_tpu),
+            ),
+        ),
+        "jnp_roll_f32": _run(
+            "jnp_roll_f32", lambda: leapfrog.solve(problem)
+        ),
+        "sharded_pallas_mesh111": _run(
+            "sharded_pallas_mesh111",
+            lambda: sharded.solve_sharded(
+                problem, mesh_shape=(1, 1, 1), kernel="pallas"
+            ),
+        ),
+        "compensated_pallas_f32": _run(
+            "compensated_pallas_f32",
+            lambda: leapfrog.solve_compensated(
+                problem,
+                comp_step_fn=stencil_pallas.make_compensated_step_fn(
+                    interpret=not on_tpu
+                ),
+            ),
+        ),
+    }
     line = {
         "metric": "gcell_updates_per_s",
         "value": round(res.gcells_per_second, 3),
@@ -59,6 +115,11 @@ def main() -> int:
         "solve_seconds": round(res.solve_seconds, 3),
         "compile_seconds": round(res.init_seconds, 3),
         "max_abs_error": float(res.abs_errors.max()),
+        "sub_benchmarks": subs,
+        "accuracy_note": (
+            "compensated_pallas_f32.max_abs_error is the BASELINE accuracy "
+            "gate: discretization bound ~4e-6 at N=512/1000"
+        ),
         "baseline_note": "6.1 Gcell/s = round-1 judge measurement, same chip",
     }
     print(json.dumps(line))

@@ -22,6 +22,12 @@ pick at runtime):
                                     (probe programs; see solver/timing.py) and
                                     add it to the report, like the reference's
                                     "new" variants (mpi_new.cpp:368-371)
+  --scheme {standard,compensated}   time-integration scheme: compensated =
+                                    Kahan incremental leapfrog, pushing f32
+                                    to the discretization limit (5.7e-6 vs
+                                    1.1e-3 L-inf at N=512/1000 on v5e, at
+                                    ~12 vs ~20 Gcell/s); single backend,
+                                    f32/f64 only
   --kernel {auto,roll,pallas}       hot-kernel selection: pallas = the fused
                                     slab kernel (kernels/stencil_pallas.py,
                                     the analog of the reference shipping its
@@ -56,7 +62,7 @@ from wavetpu.core.problem import Problem
 _KNOWN_FLAGS = (
     "backend", "mesh", "dtype", "no-errors", "out-dir", "platform",
     "phase-timing", "stop-step", "save-state", "resume",
-    "kernel", "overlap",
+    "kernel", "overlap", "scheme",
 )
 _VALUELESS = ("no-errors", "phase-timing", "overlap")
 
@@ -113,6 +119,32 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             raise ValueError(
                 f"--kernel must be auto|roll|pallas, got {flags['kernel']}"
             )
+        scheme = flags.get("scheme", "standard")
+        if scheme not in ("standard", "compensated"):
+            raise ValueError(
+                f"--scheme must be standard|compensated, got {scheme}"
+            )
+        if scheme == "compensated":
+            if flags.get("dtype") == "bf16":
+                raise ValueError("--scheme compensated requires f32/f64")
+            if (
+                flags.get("backend") == "sharded"
+                or "mesh" in flags
+                or "resume" in flags
+                or "save-state" in flags
+            ):
+                raise ValueError(
+                    "--scheme compensated currently supports the "
+                    "single-device backend without checkpointing"
+                )
+            if "phase-timing" in flags:
+                # The probe (solver/timing.py) times the standard step;
+                # reporting its numbers against a compensated solve would
+                # describe a program that never ran.
+                raise ValueError(
+                    "--phase-timing is not available for "
+                    "--scheme compensated yet"
+                )
         if flags.get("backend") == "single" and "mesh" in flags:
             raise ValueError("--mesh contradicts --backend single")
         if flags.get("backend") == "single" and "overlap" in flags:
@@ -248,7 +280,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         flags.get("kernel", "auto"), jax.default_backend()
     )
     print(f"kernel: {kernel}")
+    print(f"scheme: {scheme}")
     overlap = "overlap" in flags
+    if scheme == "compensated" and backend != "single":
+        # backendauto on a multi-device host resolves to sharded; the
+        # compensated scheme is single-device for now.
+        print(
+            "error: --scheme compensated requires --backend single",
+            file=sys.stderr,
+        )
+        return 2
 
     if backend == "sharded":
         from wavetpu.solver import sharded
@@ -302,13 +343,25 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from wavetpu.solver import leapfrog
 
         step_fn = None
+        interpret = jax.default_backend() != "tpu"
         if kernel == "pallas":
             from wavetpu.kernels import stencil_pallas
 
-            step_fn = stencil_pallas.make_step_fn(
-                interpret=jax.default_backend() != "tpu"
+            step_fn = stencil_pallas.make_step_fn(interpret=interpret)
+        if scheme == "compensated":
+            comp_step_fn = None
+            if kernel == "pallas":
+                comp_step_fn = stencil_pallas.make_compensated_step_fn(
+                    interpret=interpret
+                )
+            result = leapfrog.solve_compensated(
+                problem,
+                dtype=dtype,
+                comp_step_fn=comp_step_fn,
+                compute_errors=compute_errors,
+                stop_step=stop_step,
             )
-        if resume_state is not None:
+        elif resume_state is not None:
             u_prev0, u_cur0, start = resume_state
             # Unless --dtype was given explicitly, resume in the dtype the
             # checkpoint was saved with - casting would break the
@@ -348,6 +401,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(f"checkpoint: {ck_path}")
 
     exchange_seconds = loop_seconds = None
+    probe_steps = None
     if "phase-timing" in flags:
         from wavetpu.solver import timing
 
@@ -355,8 +409,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             problem,
             mesh_shape=mesh_shape if backend == "sharded" else (1, 1, 1),
             dtype=dtype,
+            kernel=kernel,
+            overlap=overlap,
         )
         exchange_seconds, loop_seconds = pb.exchange_seconds, pb.loop_seconds
+        probe_steps = pb.steps_measured
 
     from wavetpu.io import report
 
@@ -368,6 +425,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         errors_computed=compute_errors,
         exchange_seconds=exchange_seconds,
         loop_seconds=loop_seconds,
+        probe_steps=probe_steps,
     )
     print(f"grids initialized in {int(result.init_seconds * 1000)}ms")
     print(

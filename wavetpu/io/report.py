@@ -42,6 +42,7 @@ def format_report(
     exchange_seconds: Optional[float] = None,
     loop_seconds: Optional[float] = None,
     errors_computed: bool = True,
+    probe_steps: Optional[int] = None,
 ) -> str:
     """Render the text report body (reference line layout).
 
@@ -66,6 +67,15 @@ def format_report(
         )
     if loop_seconds is not None:
         lines.append(f"total loop time: {int(loop_seconds * 1000)}ms")
+    if probe_steps is not None and (
+        exchange_seconds is not None or loop_seconds is not None
+    ):
+        # Honesty label: unlike the reference's per-step host timers
+        # (mpi_new.cpp:200-240), these come from a probe scan of the
+        # production step body extrapolated to the full solve length.
+        lines.append(
+            f"(phase times probe-extrapolated from {probe_steps} steps)"
+        )
     return "\n".join(lines) + "\n"
 
 
@@ -78,6 +88,7 @@ def write_report(
     loop_seconds: Optional[float] = None,
     json_sidecar: bool = True,
     errors_computed: bool = True,
+    probe_steps: Optional[int] = None,
 ) -> str:
     """Write the text report (+ JSON sidecar); returns the text-file path."""
     p = result.problem
@@ -87,7 +98,8 @@ def write_report(
     with open(path, "w") as f:
         f.write(
             format_report(
-                result, exchange_seconds, loop_seconds, errors_computed
+                result, exchange_seconds, loop_seconds, errors_computed,
+                probe_steps,
             )
         )
     if json_sidecar:
@@ -112,6 +124,7 @@ def write_report(
             ),
             "exchange_seconds": exchange_seconds,
             "loop_seconds": loop_seconds,
+            "phase_probe_steps": probe_steps,
         }
         # Derive the sidecar from `name` (not `path`): out_dir may itself
         # contain ".txt".

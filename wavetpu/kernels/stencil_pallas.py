@@ -61,6 +61,7 @@ def _choose_block_depth(
     plane_elems: int,
     itemsize: int = 4,
     field_itemsize: Optional[int] = None,
+    slabs: int = 3,
 ) -> int:
     """Largest power-of-two slab depth (<= 8) whose double-buffered pipeline
     working set fits the VMEM budget (and divides `depth`).
@@ -74,9 +75,11 @@ def _choose_block_depth(
     bx=4 (measured 8.1 vs 19.5 Gcell/s on v5e).
 
     `plane_elems` is the (y, z) plane size in elements - n*n for the full
-    fundamental domain, by*bz for a shard block.
+    fundamental domain, by*bz for a shard block.  `slabs` is the number of
+    bx-deep state buffers in flight (3 for the standard kernel, 6 for the
+    compensated one: u/v/carry in + out).
     """
-    per_bx = 3 * itemsize + (field_itemsize or 0)   # bytes per plane, slabs
+    per_bx = slabs * itemsize + (field_itemsize or 0)  # bytes per plane
     halo = 2 * itemsize                             # two 1-plane halos
     bx = 1
     while (
@@ -415,6 +418,81 @@ def sharded_fused_step(u_prev, u, ghosts, offsets, n_global, *, inv_h2,
         compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )(*operands)
+
+
+def _comp_step_kernel(v_ref, carry_ref, uc_ref, ulo_ref, uhi_ref,
+                      u_out, v_out, carry_out,
+                      *, coeff, inv_h2, compute_dtype):
+    """Fused compensated (Kahan) incremental leapfrog slab.
+
+    Semantics pinned to `stencil_ref.compensated_step`: the increment
+    C*lap(u) accumulates in its own buffer and the u addition runs through
+    a two-sum carry, keeping f32 rounding at the representation level (see
+    that docstring for the measured numbers).  One kernel reads u (+2 halo
+    planes), v, carry and writes all three successors - the whole step in
+    a single HBM pass, where an unfused formulation would pay a second
+    elementwise pass over four fields.
+    """
+    f = compute_dtype
+    c = uc_ref[:].astype(f)
+    lap = _slab_laplacian(c, ulo_ref, uhi_ref, inv_h2, f)
+    d = jnp.asarray(coeff, f) * lap
+    # Dirichlet mask on the increment only: u/v/carry start masked and
+    # sums of masked fields stay masked (stencil_ref.compensated_step).
+    ym = lax.broadcasted_iota(jnp.int32, d.shape, 1) != 0
+    zm = lax.broadcasted_iota(jnp.int32, d.shape, 2) != 0
+    d = jnp.where(ym & zm, d, jnp.asarray(0.0, f))
+    v_next = v_ref[:].astype(f) + d
+    y = v_next - carry_ref[:].astype(f)
+    t = c + y
+    carry_next = (t - c) - y
+    u_out[:] = t.astype(u_out.dtype)
+    v_out[:] = v_next.astype(v_out.dtype)
+    carry_out[:] = carry_next.astype(carry_out.dtype)
+
+
+def compensated_step(u, v, carry, problem: Problem, coeff=None, *,
+                     block_x=None, interpret=False):
+    """Fused (u, v, carry) -> (u', v', carry') compensated leapfrog step.
+
+    Drop-in for `stencil_ref.compensated_step` (same signature semantics);
+    `coeff` defaults to a2tau2, the layer-1 bootstrap passes a2tau2/2 with
+    v = carry = 0.
+    """
+    n = u.shape[0]
+    f = stencil_ref.compute_dtype(u.dtype)
+    bx = block_x or _choose_block_depth(n, n * n, u.dtype.itemsize, slabs=6)
+    if n % bx:
+        raise ValueError(f"block_x={bx} must divide N={n}")
+    slab, lo, hi = _specs(n, bx)
+    kernel = functools.partial(
+        _comp_step_kernel,
+        coeff=problem.a2tau2 if coeff is None else coeff,
+        inv_h2=problem.inv_h2, compute_dtype=f,
+    )
+    out = jax.ShapeDtypeStruct(u.shape, u.dtype)
+    return pl.pallas_call(
+        kernel,
+        grid=(n // bx,),
+        in_specs=[slab, slab, slab, lo, hi],
+        out_specs=[slab, slab, slab],
+        out_shape=[out, out, out],
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        interpret=interpret,
+    )(v, carry, u, u, u)
+
+
+def make_compensated_step_fn(block_x=None, interpret=False):
+    """A `(u, v, carry, problem, coeff) -> (u', v', carry')` closure for
+    `leapfrog.make_compensated_solver(comp_step_fn=...)`."""
+
+    def step(u, v, carry, problem, coeff=None):
+        return compensated_step(
+            u, v, carry, problem, coeff,
+            block_x=block_x, interpret=interpret,
+        )
+
+    return step
 
 
 def make_step_fn(block_x=None, interpret=False, c2tau2_field=None):

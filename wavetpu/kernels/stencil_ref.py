@@ -131,6 +131,43 @@ def make_variable_c_step(c2tau2_field):
     return ParamStep(step, ParamStep.materialize(np.asarray(c2tau2_field)))
 
 
+def compensated_step(u, v, carry, problem: Problem, coeff=None):
+    """One step of the compensated (Kahan) incremental leapfrog.
+
+    Algebraically identical to `leapfrog_step` via the increment form
+    v_n = u_n - u_{n-1}:
+
+        v_{n+1} = v_n + C*lap(u_n)
+        u_{n+1} = u_n + v_{n+1}          (compensated two-sum)
+
+    but numerically far better in f32: the standard form adds the tiny
+    update C*lap(u) (~1e-5 at N=512) into O(1) state and loses its low
+    bits every step - measured 1.09e-3 L-inf error at N=512/1000 vs the
+    ~4e-6 discretization bound (BENCH_r03).  Here the increment
+    accumulates in its own small-magnitude buffer and the u addition runs
+    Kahan-compensated through `carry`, so rounding stays at the one-time
+    f32 representation level (measured ~2e-7 vs f64 at N=128/1000 - a
+    ~7000x reduction; the analytic error then equals f64's).
+
+    The Dirichlet mask is applied to the increment only: u, v, carry all
+    start masked and sums of masked fields stay masked.
+
+    `coeff` defaults to a2tau2; the layer-1 bootstrap is this same step
+    with v = carry = 0 and coeff = a2tau2/2 (then u1 = u0 + (C/2)lap(u0),
+    the Taylor half-step, openmp_sol.cpp:137-144).
+    """
+    c = jnp.asarray(
+        problem.a2tau2 if coeff is None else coeff, dtype=u.dtype
+    )
+    d = apply_dirichlet(c * laplacian(u, problem.inv_h2))
+    v_next = v + d
+    # Kahan two-sum: u_next = u + v_next with error fed back via carry.
+    y = v_next - carry
+    t = u + y
+    carry_next = (t - u) - y
+    return t, v_next, carry_next
+
+
 def laplacian_ext(ext, inv_h2):
     """7-point Laplacian of the interior of a halo-extended block.
 

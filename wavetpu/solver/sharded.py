@@ -197,6 +197,7 @@ def _make_local_step(
     kernel: str,
     overlap: bool,
     interpret: bool,
+    exchange: bool = True,
 ):
     """Build the per-shard step function `step(u_prev, u, bc, field)`.
 
@@ -205,6 +206,11 @@ def _make_local_step(
     per-cell `field` block.  Runs inside shard_map.  The layer-1 bootstrap
     derives from this same function ((u0 + step(u0, u0))/2), so any kernel
     choice bootstraps consistently.
+
+    `exchange=False` substitutes the local wrap planes for the ppermute'd
+    ghosts - the identical program minus ICI traffic.  It exists ONLY for
+    the phase-timing probe (solver/timing.py): the numbers it produces are
+    wrong at shard boundaries whenever a mesh axis is >1.
     """
     if kernel not in ("roll", "pallas"):
         raise ValueError(f"kernel must be 'roll' or 'pallas', got {kernel!r}")
@@ -239,7 +245,9 @@ def _make_local_step(
         return (u_next * bc.astype(f)).astype(dtype)
 
     def step_serial(u_prev, u, bc, field):
-        ghosts = halo.collect_ghosts(u, topo)
+        ghosts = (
+            halo.collect_ghosts(u, topo) if exchange else _self_ghosts(u)
+        )
         if kernel == "pallas":
             u_in = halo.absorb_hi_ghosts(u, ghosts, topo)
             return pallas_update(u_prev, u_in, ghosts, field)
@@ -249,7 +257,9 @@ def _make_local_step(
     def step_overlap(u_prev, u, bc, field):
         # The 6 ppermutes launch first and feed ONLY the face patches, so
         # the scheduler can overlap them with the bulk update below.
-        ghosts = halo.collect_ghosts(u, topo)
+        ghosts = (
+            halo.collect_ghosts(u, topo) if exchange else _self_ghosts(u)
+        )
         if kernel == "pallas":
             bulk = pallas_update(u_prev, u, _self_ghosts(u), field)
         else:
