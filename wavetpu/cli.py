@@ -26,8 +26,9 @@ pick at runtime):
                                     Kahan incremental leapfrog, pushing f32
                                     to the discretization limit (5.7e-6 vs
                                     1.1e-3 L-inf at N=512/1000 on v5e, at
-                                    ~12 vs ~20 Gcell/s); single backend,
-                                    f32/f64 only
+                                    ~12 vs ~20 Gcell/s); f32/f64, single or
+                                    sharded backend (no checkpoint/overlap
+                                    yet)
   --kernel {auto,roll,pallas}       hot-kernel selection: pallas = the fused
                                     slab kernel (kernels/stencil_pallas.py,
                                     the analog of the reference shipping its
@@ -39,6 +40,14 @@ pick at runtime):
   --overlap                         overlap halo exchange with the bulk
                                     stencil update (sharded backend, even
                                     shard splits only)
+  --distributed                     multi-process launch: call
+                                    jax.distributed.initialize() (explicit
+                                    JAX_COORDINATOR_ADDRESS /
+                                    JAX_NUM_PROCESSES / JAX_PROCESS_ID env
+                                    vars, or the TPU-pod auto-detection)
+                                    and gate stdout + the report file on
+                                    process 0 - the rank-0 gating of every
+                                    reference variant (mpi_new.cpp:356-371)
   --stop-step S                     halt after layer S (tau unchanged); pairs
                                     with --save-state for preemptible runs
   --save-state PATH                 write the final (u_prev, u_cur, step)
@@ -62,9 +71,9 @@ from wavetpu.core.problem import Problem
 _KNOWN_FLAGS = (
     "backend", "mesh", "dtype", "no-errors", "out-dir", "platform",
     "phase-timing", "stop-step", "save-state", "resume",
-    "kernel", "overlap", "scheme",
+    "kernel", "overlap", "scheme", "distributed",
 )
-_VALUELESS = ("no-errors", "phase-timing", "overlap")
+_VALUELESS = ("no-errors", "phase-timing", "overlap", "distributed")
 
 
 def resolve_kernel(flag_value: str, platform: str) -> str:
@@ -127,15 +136,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if scheme == "compensated":
             if flags.get("dtype") == "bf16":
                 raise ValueError("--scheme compensated requires f32/f64")
-            if (
-                flags.get("backend") == "sharded"
-                or "mesh" in flags
-                or "resume" in flags
-                or "save-state" in flags
-            ):
+            if "resume" in flags or "save-state" in flags:
                 raise ValueError(
-                    "--scheme compensated currently supports the "
-                    "single-device backend without checkpointing"
+                    "--scheme compensated does not support checkpointing "
+                    "yet (its state is three buffers, not two)"
+                )
+            if "overlap" in flags:
+                raise ValueError(
+                    "--overlap is not available for --scheme compensated yet"
                 )
             if "phase-timing" in flags:
                 # The probe (solver/timing.py) times the standard step;
@@ -224,8 +232,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f"error: cannot load checkpoint: {e}", file=sys.stderr)
             return 2
 
+    distributed = "distributed" in flags
     # Courant printout before solving (openmp_sol.cpp:214, mpi_new.cpp:404).
-    print(f"C = {problem.courant:.6g}")
+    # Under --distributed it waits until the process index is known so only
+    # process 0 speaks (rank-0 gating, mpi_new.cpp:356-371).
+    if not distributed:
+        print(f"C = {problem.courant:.6g}")
 
     import os
 
@@ -240,6 +252,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     platform = flags.get("platform") or os.environ.get("JAX_PLATFORMS")
     if platform and platform != jax.config.jax_platforms:
         jax.config.update("jax_platforms", platform)
+
+    if distributed:
+        dist_kwargs = {}
+        addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
+        if addr:
+            # Explicit env-var cluster (the CPU smoke-test path and any
+            # launcher that exports these); without them initialize()
+            # auto-detects TPU pod / GKE / SLURM environments.
+            dist_kwargs = dict(
+                coordinator_address=addr,
+                num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+                process_id=int(os.environ["JAX_PROCESS_ID"]),
+            )
+        jax.distributed.initialize(**dist_kwargs)
+    is_main = jax.process_index() == 0
+    say = print if is_main else (lambda *a, **k: None)
+    if distributed:
+        say(f"C = {problem.courant:.6g}")
 
     dtype = {
         "f32": jnp.float32,
@@ -279,17 +309,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     kernel = resolve_kernel(
         flags.get("kernel", "auto"), jax.default_backend()
     )
-    print(f"kernel: {kernel}")
-    print(f"scheme: {scheme}")
+    say(f"kernel: {kernel}")
+    say(f"scheme: {scheme}")
     overlap = "overlap" in flags
-    if scheme == "compensated" and backend != "single":
-        # backendauto on a multi-device host resolves to sharded; the
-        # compensated scheme is single-device for now.
-        print(
-            "error: --scheme compensated requires --backend single",
-            file=sys.stderr,
-        )
-        return 2
 
     if backend == "sharded":
         from wavetpu.solver import sharded
@@ -333,6 +355,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 kernel=kernel,
                 overlap=overlap,
                 stop_step=stop_step,
+                scheme=scheme,
             )
             from wavetpu.core.grid import choose_mesh_shape
 
@@ -393,12 +416,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from wavetpu.io import checkpoint as _ckpt
 
         if backend == "sharded":
+            # Multi-process aware internally: each process writes only its
+            # addressable shards, meta is gated on process 0.
             ck_path = _ckpt.save_sharded_checkpoint(
                 flags["save-state"], result
             )
-        else:
+            say(f"checkpoint: {ck_path}")
+        elif is_main:
+            # Single-device state is fully replicated; one writer suffices
+            # (concurrent np.savez to one path is not atomic).
             ck_path = _ckpt.save_checkpoint(flags["save-state"], result)
-        print(f"checkpoint: {ck_path}")
+            say(f"checkpoint: {ck_path}")
 
     exchange_seconds = loop_seconds = None
     probe_steps = None
@@ -415,30 +443,32 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         exchange_seconds, loop_seconds = pb.exchange_seconds, pb.loop_seconds
         probe_steps = pb.steps_measured
 
-    from wavetpu.io import report
+    if is_main:
+        from wavetpu.io import report
 
-    path = report.write_report(
-        result,
-        out_dir=out_dir,
-        n_procs=n_procs,
-        variant=variant,
-        errors_computed=compute_errors,
-        exchange_seconds=exchange_seconds,
-        loop_seconds=loop_seconds,
-        probe_steps=probe_steps,
-    )
-    print(f"grids initialized in {int(result.init_seconds * 1000)}ms")
-    print(
+        path = report.write_report(
+            result,
+            out_dir=out_dir,
+            n_procs=n_procs,
+            variant=variant,
+            errors_computed=compute_errors,
+            exchange_seconds=exchange_seconds,
+            loop_seconds=loop_seconds,
+            probe_steps=probe_steps,
+        )
+    say(f"grids initialized in {int(result.init_seconds * 1000)}ms")
+    say(
         f"numerical solution calculated in "
         f"{int(result.solve_seconds * 1000)}ms"
     )
     if exchange_seconds is not None:
-        print(f"total ICI exchange time: {int(exchange_seconds * 1000)}ms")
-        print(f"total loop time: {int(loop_seconds * 1000)}ms")
+        say(f"total ICI exchange time: {int(exchange_seconds * 1000)}ms")
+        say(f"total loop time: {int(loop_seconds * 1000)}ms")
     if compute_errors:
-        print(f"max abs error: {result.abs_errors.max():.6g}")
-    print(f"throughput: {result.gcells_per_second:.3f} Gcell-updates/s")
-    print(f"report: {path}")
+        say(f"max abs error: {result.abs_errors.max():.6g}")
+    say(f"throughput: {result.gcells_per_second:.3f} Gcell-updates/s")
+    if is_main:
+        say(f"report: {path}")
     return 0
 
 

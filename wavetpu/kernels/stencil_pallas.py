@@ -235,48 +235,14 @@ def taylor_half_step(u0, problem: Problem, *, block_x=None, interpret=False):
     )
 
 
-def _sharded_kernel(*refs, alpha, beta, coeff, has_field, need, pad,
-                    n_global, block_x, inv_h2, compute_dtype):
-    """Per-shard fused update slab - the distributed counterpart of
-    `_step_kernel`, the analog of the reference's per-rank CUDA kernel
-    launch (cuda_sol.cpp:381-443 driving calculate_layer,
-    cuda_sol_kernels.cu:24-47).
+def _ghost_lap(c, ulo_ref, uhi_ref, ghost_refs, need, inv_h2, f):
+    """7-pt Laplacian of a shard slab with statically-specialized ghost
+    handling (see `_sharded_kernel` for the per-axis semantics).
 
-    Statically specialized per axis on the mesh shape:
-
-     * `need[a]` (mesh dim > 1): the axis's shard-boundary neighbours come
-       from ppermute'd ghost operands - the x halo overrides the wraparound
-       BlockSpec planes at the grid edges, y/z ghosts override the wrapped
-       row/lane of the in-VMEM roll via an iota select.  On a 1-shard axis
-       the in-shard wrap IS the global neighbour (periodic x / stored zero
-       Dirichlet plane in y/z), so no ghost operands and no selects exist
-       at all - a (1,1,1) mesh compiles to the single-device kernel's data
-       path.
-     * `pad[a]` (uneven shards): the global-index < N mask component only
-       exists on axes that actually carry pad planes.
-
-    The y/z Dirichlet zeroing (global index != 0) is always applied, from
-    the shard offsets in SMEM - the generalization of `_finish_update`'s
-    local y=0/z=0 masking to arbitrary shard position.  All masking stays
-    fused in the store: no HBM traffic.
+    `ghost_refs` is (xlo, xhi, ylo, yhi, zlo, zhi) with None entries on
+    axes whose mesh dim is 1 (`need[a]` False).
     """
-    f = compute_dtype
-    it = iter(refs[:-1])
-    out_ref = refs[-1]
-    off_ref = next(it)
-    c2_ref = next(it) if has_field else None
-    uprev_ref = next(it)
-    uc_ref = next(it)
-    ulo_ref = next(it)
-    uhi_ref = next(it)
-    xlo_ref = next(it) if need[0] else None
-    xhi_ref = next(it) if need[0] else None
-    ylo_ref = next(it) if need[1] else None
-    yhi_ref = next(it) if need[1] else None
-    zlo_ref = next(it) if need[2] else None
-    zhi_ref = next(it) if need[2] else None
-
-    c = uc_ref[:].astype(f)
+    xlo_ref, xhi_ref, ylo_ref, yhi_ref, zlo_ref, zhi_ref = ghost_refs
     shape = c.shape
     ix, iy, iz = (jnp.asarray(v, f) for v in inv_h2)
     i = pl.program_id(0)
@@ -305,23 +271,20 @@ def _sharded_kernel(*refs, alpha, beta, coeff, has_field, need, pad,
         iota_z = lax.broadcasted_iota(jnp.int32, shape, 2)
         dn = jnp.where(iota_z == 0, zlo_ref[:].astype(f), dn)
         up = jnp.where(iota_z == nz - 1, zhi_ref[:].astype(f), up)
-    lap = lap + (dn + up - 2.0 * c) * iz
+    return lap + (dn + up - 2.0 * c) * iz
 
-    if has_field:
-        u_next = jnp.asarray(alpha, f) * c + c2_ref[:].astype(f) * lap
-    else:
-        u_next = jnp.asarray(alpha, f) * c + jnp.asarray(coeff, f) * lap
-    if beta:
-        u_next = u_next - jnp.asarray(beta, f) * uprev_ref[:].astype(f)
 
-    # Fused boundary/pad mask (reference: the whole prepare_layer pass,
-    # openmp_sol.cpp:104-112, plus pad-cell re-zeroing).
+def _global_mask(off_ref, shape, pad, n_global, block_x):
+    """Fused boundary/pad mask (reference: the whole prepare_layer pass,
+    openmp_sol.cpp:104-112, plus pad-cell re-zeroing): the y/z Dirichlet
+    zeroing (global index != 0) always, the global-index < N pad component
+    only on axes that actually carry pad planes."""
     gy = off_ref[1] + lax.broadcasted_iota(jnp.int32, shape, 1)
     gz = off_ref[2] + lax.broadcasted_iota(jnp.int32, shape, 2)
     mask = (gy != 0) & (gz != 0)
     if pad[0]:
         gx = (
-            off_ref[0] + i * block_x
+            off_ref[0] + pl.program_id(0) * block_x
             + lax.broadcasted_iota(jnp.int32, shape, 0)
         )
         mask &= gx < n_global
@@ -329,9 +292,149 @@ def _sharded_kernel(*refs, alpha, beta, coeff, has_field, need, pad,
         mask &= gy < n_global
     if pad[2]:
         mask &= gz < n_global
+    return mask
+
+
+def _take_ghost_refs(it, need):
+    """Pull the present ghost refs off the operand iterator, None-filling
+    the axes that need none (mesh dim 1)."""
+    refs = []
+    for a in range(3):
+        if need[a]:
+            refs.append(next(it))
+            refs.append(next(it))
+        else:
+            refs.extend((None, None))
+    return tuple(refs)
+
+
+def _sharded_kernel(*refs, alpha, beta, coeff, has_field, need, pad,
+                    n_global, block_x, inv_h2, compute_dtype):
+    """Per-shard fused update slab - the distributed counterpart of
+    `_step_kernel`, the analog of the reference's per-rank CUDA kernel
+    launch (cuda_sol.cpp:381-443 driving calculate_layer,
+    cuda_sol_kernels.cu:24-47).
+
+    Statically specialized per axis on the mesh shape:
+
+     * `need[a]` (mesh dim > 1): the axis's shard-boundary neighbours come
+       from ppermute'd ghost operands - the x halo overrides the wraparound
+       BlockSpec planes at the grid edges, y/z ghosts override the wrapped
+       row/lane of the in-VMEM roll via an iota select.  On a 1-shard axis
+       the in-shard wrap IS the global neighbour (periodic x / stored zero
+       Dirichlet plane in y/z), so no ghost operands and no selects exist
+       at all - a (1,1,1) mesh compiles to the single-device kernel's data
+       path.
+     * `pad[a]` (uneven shards): the global-index < N mask component only
+       exists on axes that actually carry pad planes.
+
+    All masking stays fused in the store: no HBM traffic.
+    """
+    f = compute_dtype
+    it = iter(refs[:-1])
+    out_ref = refs[-1]
+    off_ref = next(it)
+    c2_ref = next(it) if has_field else None
+    uprev_ref = next(it)
+    uc_ref = next(it)
+    ulo_ref = next(it)
+    uhi_ref = next(it)
+    ghost_refs = _take_ghost_refs(it, need)
+
+    c = uc_ref[:].astype(f)
+    lap = _ghost_lap(c, ulo_ref, uhi_ref, ghost_refs, need, inv_h2, f)
+    if has_field:
+        u_next = jnp.asarray(alpha, f) * c + c2_ref[:].astype(f) * lap
+    else:
+        u_next = jnp.asarray(alpha, f) * c + jnp.asarray(coeff, f) * lap
+    if beta:
+        u_next = u_next - jnp.asarray(beta, f) * uprev_ref[:].astype(f)
+
+    mask = _global_mask(off_ref, u_next.shape, pad, n_global, block_x)
     out_ref[:] = jnp.where(mask, u_next, jnp.asarray(0.0, f)).astype(
         out_ref.dtype
     )
+
+
+def _sharded_comp_kernel(*refs, coeff, need, pad, n_global, block_x,
+                         inv_h2, compute_dtype):
+    """Per-shard fused compensated (Kahan) leapfrog slab - `_comp_step_kernel`
+    with the sharded ghost handling and global mask of `_sharded_kernel`.
+    Reads v/carry/u (+ghosts), writes u'/v'/carry' in one HBM pass."""
+    f = compute_dtype
+    it = iter(refs[:-3])
+    u_out, v_out, carry_out = refs[-3:]
+    off_ref = next(it)
+    v_ref = next(it)
+    carry_ref = next(it)
+    uc_ref = next(it)
+    ulo_ref = next(it)
+    uhi_ref = next(it)
+    ghost_refs = _take_ghost_refs(it, need)
+
+    c = uc_ref[:].astype(f)
+    lap = _ghost_lap(c, ulo_ref, uhi_ref, ghost_refs, need, inv_h2, f)
+    d = jnp.asarray(coeff, f) * lap
+    # Mask the increment (u/v/carry start masked and sums of masked fields
+    # stay masked, stencil_ref.compensated_step) AND the stored u: the pad
+    # plane of the input block holds the absorbed hi ghost on uneven axes
+    # (halo.absorb_hi_ghosts) and must not leak into the carry state.  At
+    # masked cells y = 0, so carry_next there is 0 regardless.
+    mask = _global_mask(off_ref, d.shape, pad, n_global, block_x)
+    d = jnp.where(mask, d, jnp.asarray(0.0, f))
+    v_next = v_ref[:].astype(f) + d
+    y = v_next - carry_ref[:].astype(f)
+    t = c + y
+    carry_next = (t - c) - y
+    u_out[:] = jnp.where(mask, t, jnp.asarray(0.0, f)).astype(u_out.dtype)
+    v_out[:] = v_next.astype(v_out.dtype)
+    carry_out[:] = carry_next.astype(carry_out.dtype)
+
+
+def _sharded_geometry(u, bx, mesh_shape, r_last):
+    """BlockSpecs and per-axis static flags shared by the sharded kernels."""
+    bx_tot, by, bz = u.shape
+    need = tuple(m > 1 for m in mesh_shape)
+    if r_last is None:
+        pads = (False, False, False)
+    else:
+        pads = tuple(r != b for r, b in zip(r_last, u.shape))
+    specs = dict(
+        slab=pl.BlockSpec((bx, by, bz), lambda i: (i, 0, 0),
+                          memory_space=pltpu.VMEM),
+        lo=pl.BlockSpec((1, by, bz),
+                        lambda i: ((i * bx - 1) % bx_tot, 0, 0),
+                        memory_space=pltpu.VMEM),
+        hi=pl.BlockSpec((1, by, bz),
+                        lambda i: (((i + 1) * bx) % bx_tot, 0, 0),
+                        memory_space=pltpu.VMEM),
+        gx=pl.BlockSpec((1, by, bz), lambda i: (0, 0, 0),
+                        memory_space=pltpu.VMEM),
+        gy=pl.BlockSpec((bx, 1, bz), lambda i: (i, 0, 0),
+                        memory_space=pltpu.VMEM),
+        gz=pl.BlockSpec((bx, by, 1), lambda i: (i, 0, 0),
+                        memory_space=pltpu.VMEM),
+        smem=pl.BlockSpec(memory_space=pltpu.SMEM),
+    )
+    return need, pads, specs
+
+
+def _append_ghosts(in_specs, operands, specs, need, ghosts):
+    for needed, spec_name, (g_lo, g_hi) in zip(
+        need, ("gx", "gy", "gz"), ghosts
+    ):
+        if needed:
+            in_specs += [specs[spec_name], specs[spec_name]]
+            operands += [g_lo, g_hi]
+
+
+def _out_struct(u):
+    """Output aval matching the state it replaces; under shard_map with
+    check_vma it must declare which mesh axes it varies over."""
+    vma = getattr(getattr(u, "aval", None), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(u.shape, u.dtype, vma=vma)
+    return jax.ShapeDtypeStruct(u.shape, u.dtype)
 
 
 def sharded_fused_step(u_prev, u, ghosts, offsets, n_global, *, inv_h2,
@@ -362,39 +465,17 @@ def sharded_fused_step(u_prev, u, ghosts, offsets, n_global, *, inv_h2,
     )
     if bx_tot % bx:
         raise ValueError(f"block_x={bx} must divide shard depth {bx_tot}")
-    need = tuple(m > 1 for m in mesh_shape)
-    if r_last is None:
-        pads = (False, False, False)
-    else:
-        pads = tuple(r != b for r, b in zip(r_last, u.shape))
+    need, pads, specs = _sharded_geometry(u, bx, mesh_shape, r_last)
+    slab, lo, hi = specs["slab"], specs["lo"], specs["hi"]
 
-    slab = pl.BlockSpec((bx, by, bz), lambda i: (i, 0, 0),
-                        memory_space=pltpu.VMEM)
-    lo = pl.BlockSpec((1, by, bz), lambda i: ((i * bx - 1) % bx_tot, 0, 0),
-                      memory_space=pltpu.VMEM)
-    hi = pl.BlockSpec((1, by, bz),
-                      lambda i: (((i + 1) * bx) % bx_tot, 0, 0),
-                      memory_space=pltpu.VMEM)
-    gx = pl.BlockSpec((1, by, bz), lambda i: (0, 0, 0),
-                      memory_space=pltpu.VMEM)
-    gy = pl.BlockSpec((bx, 1, bz), lambda i: (i, 0, 0),
-                      memory_space=pltpu.VMEM)
-    gz = pl.BlockSpec((bx, by, 1), lambda i: (i, 0, 0),
-                      memory_space=pltpu.VMEM)
-    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
-
-    (xg, yg, zg) = ghosts
-    in_specs = [smem]
+    in_specs = [specs["smem"]]
     operands = [jnp.asarray(offsets, jnp.int32)]
     if has_field:
         in_specs.append(slab)
         operands.append(jnp.asarray(c2tau2_block, dtype=compute_dtype))
     in_specs += [slab, slab, lo, hi]
     operands += [u_prev, u, u, u]
-    for needed, spec, (g_lo, g_hi) in zip(need, (gx, gy, gz), (xg, yg, zg)):
-        if needed:
-            in_specs += [spec, spec]
-            operands += [g_lo, g_hi]
+    _append_ghosts(in_specs, operands, specs, need, ghosts)
 
     kernel = functools.partial(
         _sharded_kernel,
@@ -402,19 +483,51 @@ def sharded_fused_step(u_prev, u, ghosts, offsets, n_global, *, inv_h2,
         need=need, pad=pads, n_global=n_global, block_x=bx,
         inv_h2=inv_h2, compute_dtype=compute_dtype,
     )
-    # Under shard_map with check_vma the output aval must declare which mesh
-    # axes it varies over - same as the input state it replaces.
-    vma = getattr(getattr(u, "aval", None), "vma", None)
-    if vma:
-        out_shape = jax.ShapeDtypeStruct(u.shape, u.dtype, vma=vma)
-    else:
-        out_shape = jax.ShapeDtypeStruct(u.shape, u.dtype)
     return pl.pallas_call(
         kernel,
         grid=(bx_tot // bx,),
         in_specs=in_specs,
         out_specs=slab,
-        out_shape=out_shape,
+        out_shape=_out_struct(u),
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        interpret=interpret,
+    )(*operands)
+
+
+def sharded_compensated_step(u, v, carry, ghosts, offsets, n_global, *,
+                             inv_h2, mesh_shape, r_last=None, coeff,
+                             block_x=None, interpret=False,
+                             compute_dtype=None):
+    """Fused compensated (Kahan) leapfrog step of a shard block - the
+    sharded counterpart of `compensated_step`, with ghosts/masking as in
+    `sharded_fused_step`.  Returns (u', v', carry')."""
+    bx_tot, by, bz = u.shape
+    if compute_dtype is None:
+        compute_dtype = stencil_ref.compute_dtype(u.dtype)
+    bx = block_x or _choose_block_depth(
+        bx_tot, by * bz, u.dtype.itemsize, slabs=6
+    )
+    if bx_tot % bx:
+        raise ValueError(f"block_x={bx} must divide shard depth {bx_tot}")
+    need, pads, specs = _sharded_geometry(u, bx, mesh_shape, r_last)
+    slab, lo, hi = specs["slab"], specs["lo"], specs["hi"]
+
+    in_specs = [specs["smem"], slab, slab, slab, lo, hi]
+    operands = [jnp.asarray(offsets, jnp.int32), v, carry, u, u, u]
+    _append_ghosts(in_specs, operands, specs, need, ghosts)
+
+    kernel = functools.partial(
+        _sharded_comp_kernel,
+        coeff=coeff, need=need, pad=pads, n_global=n_global, block_x=bx,
+        inv_h2=inv_h2, compute_dtype=compute_dtype,
+    )
+    out = _out_struct(u)
+    return pl.pallas_call(
+        kernel,
+        grid=(bx_tot // bx,),
+        in_specs=in_specs,
+        out_specs=[slab, slab, slab],
+        out_shape=[out, out, out],
         compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )(*operands)

@@ -307,6 +307,55 @@ def _make_local_step(
     return step_overlap if overlap else step_serial
 
 
+def _make_local_comp_step(
+    problem: Problem,
+    topo: Topology,
+    dtype,
+    kernel: str,
+    interpret: bool,
+    exchange: bool = True,
+):
+    """Per-shard compensated (Kahan) step `(u, v, carry, bc, coeff) ->
+    (u', v', carry')` - the sharded counterpart of
+    stencil_ref.compensated_step; ghosts/masking as in `_make_local_step`.
+    """
+    if kernel not in ("roll", "pallas"):
+        raise ValueError(f"kernel must be 'roll' or 'pallas', got {kernel!r}")
+    f = stencil_ref.compute_dtype(dtype)
+    if f != dtype:
+        raise ValueError(
+            "compensated scheme requires f32/f64 state (bf16 representation "
+            "error dominates anything the compensation recovers)"
+        )
+    n = problem.N
+    inv_h2 = problem.inv_h2
+
+    def comp_step(u, v, carry, bc, coeff):
+        ghosts = (
+            halo.collect_ghosts(u, topo) if exchange else _self_ghosts(u)
+        )
+        if kernel == "pallas":
+            u_in = halo.absorb_hi_ghosts(u, ghosts, topo)
+            return stencil_pallas.sharded_compensated_step(
+                u_in, v, carry, ghosts, _shard_offsets(topo), n,
+                inv_h2=inv_h2, mesh_shape=topo.mesh_shape,
+                r_last=topo.r_last, coeff=coeff,
+                interpret=interpret, compute_dtype=f,
+            )
+        ext = halo.place_ghosts(u, ghosts, topo)
+        lap = stencil_ref.laplacian_ext(ext.astype(f), inv_h2)
+        d = (jnp.asarray(coeff, f) * lap) * bc.astype(f)
+        v_next = v + d
+        y = v_next - carry
+        t = u + y
+        carry_next = (t - u) - y
+        # bc re-applied to the sum for store parity with the Pallas
+        # kernel's masked store (a no-op here: u and d are both masked).
+        return t * bc.astype(f), v_next, carry_next
+
+    return comp_step
+
+
 def _local_solve_fns(
     problem: Problem,
     topo: Topology,
@@ -315,10 +364,27 @@ def _local_solve_fns(
     kernel: str,
     overlap: bool,
     interpret: bool,
+    scheme: str = "standard",
 ):
     """The per-shard solve/resume bodies (closed over by shard_map)."""
     f = stencil_ref.compute_dtype(dtype)
-    step = _make_local_step(problem, topo, dtype, kernel, overlap, interpret)
+    if scheme not in ("standard", "compensated"):
+        raise ValueError(
+            f"scheme must be 'standard' or 'compensated', got {scheme!r}"
+        )
+    compensated = scheme == "compensated"
+    if compensated and overlap:
+        raise ValueError("overlap mode is not available for the "
+                         "compensated scheme yet")
+    if compensated:
+        comp_step = _make_local_comp_step(
+            problem, topo, dtype, kernel, interpret
+        )
+        step = None
+    else:
+        step = _make_local_step(
+            problem, topo, dtype, kernel, overlap, interpret
+        )
 
     def errors_fn(mex, mey, mez, sx, sy, sz, ct):
         def errors(u, layer):
@@ -335,32 +401,57 @@ def _local_solve_fns(
         return errors
 
     def bootstrap(sx, sy, sz, bcx, bcy, bcz, ct, field):
-        """Layers 0 and 1 (calculate_start, mpi_new.cpp:271-316)."""
+        """Layers 0 and 1 (calculate_start, mpi_new.cpp:271-316).
+
+        Returns (bc, carry0) where carry0 is the scan carry at layer 1:
+        (u0, u1) for the standard scheme, (u1, v1, carry1) for the
+        compensated one (the same step with v = carry = 0 and coeff = C/2
+        is exactly the Taylor half-step bootstrap).
+        """
         bc = (
             bcx[:, None, None] * bcy[None, :, None] * bcz[None, None, :]
         )
         u0 = (oracle.analytic_field(sx, sy, sz, ct[0]) * bc).astype(dtype)
+        if compensated:
+            zero = jnp.zeros_like(u0)
+            u1, v1, c1 = comp_step(
+                u0, zero, zero, bc, 0.5 * problem.a2tau2
+            )
+            return bc, (u1, v1, c1), u1
         # Layer 1 derived from the step function (u1 = (u0 + step(u0, u0))/2
         # == u0 + C/2 lap(u0)), so the kernel choice and a variable-c field
         # bootstrap consistently - same trick as leapfrog.make_solver.
         s = step(u0, u0, bc, field)
         u1 = (0.5 * (u0.astype(f) + s.astype(f))).astype(dtype)
-        return bc, u0, u1
+        return bc, (u0, u1), u1
 
-    def scan_layers(step_args, u_prev, u_cur, start, stop, errors):
+    def scan_layers(step_args, carry0, start, stop, errors):
         bc, field = step_args
 
-        def body(carry, layer):
-            u_prev, u = carry
-            u_next = step(u_prev, u, bc, field)
-            ae, re = errors(u_next, layer)
-            return (u, u_next), (ae, re)
+        if compensated:
+            def body(carry, layer):
+                u, v, c = carry
+                u2, v2, c2 = comp_step(u, v, c, bc, problem.a2tau2)
+                ae, re = errors(u2, layer)
+                return (u2, v2, c2), (ae, re)
+        else:
+            def body(carry, layer):
+                u_prev, u = carry
+                u_next = step(u_prev, u, bc, field)
+                ae, re = errors(u_next, layer)
+                return (u, u_next), (ae, re)
 
-        return lax.scan(
-            body, (u_prev, u_cur), jnp.arange(start + 1, stop + 1)
-        )
+        return lax.scan(body, carry0, jnp.arange(start + 1, stop + 1))
 
-    return errors_fn, bootstrap, scan_layers
+    def final_state(carry):
+        """(u_prev, u_cur) from the scan carry; the compensated carry
+        reconstructs u_prev from the increment (leapfrog.py rationale)."""
+        if compensated:
+            u, v, c = carry
+            return u - v, u
+        return carry
+
+    return errors_fn, bootstrap, scan_layers, final_state
 
 
 def _replicated_inputs(problem, topo, dtype):
@@ -383,6 +474,7 @@ def make_sharded_solver(
     interpret: bool = False,
     has_field: bool = False,
     stop_step: Optional[int] = None,
+    scheme: str = "standard",
 ):
     """Build the jitted end-to-end sharded solver.
 
@@ -399,19 +491,25 @@ def make_sharded_solver(
         )
     f = stencil_ref.compute_dtype(dtype)
     (sx, sy, sz), bcs, mes, ct = _replicated_inputs(problem, topo, dtype)
-    errors_fn, bootstrap, scan_layers = _local_solve_fns(
-        problem, topo, dtype, compute_errors, kernel, overlap, interpret
+    if scheme == "compensated" and has_field:
+        raise ValueError(
+            "compensated scheme does not support a variable-c field yet"
+        )
+    errors_fn, bootstrap, scan_layers, final_state = _local_solve_fns(
+        problem, topo, dtype, compute_errors, kernel, overlap, interpret,
+        scheme,
     )
 
     def local_solve(sx, sy, sz, bcx, bcy, bcz, mex, mey, mez, ct, *rest):
         field = rest[0] if has_field else None
         errors = errors_fn(mex, mey, mez, sx, sy, sz, ct)
-        bc, u0, u1 = bootstrap(sx, sy, sz, bcx, bcy, bcz, ct, field)
+        bc, carry0, u1 = bootstrap(sx, sy, sz, bcx, bcy, bcz, ct, field)
         a0 = r0 = jnp.zeros((), f)  # layer 0 assigned from the oracle
         a1, r1 = errors(u1, 1)
-        (u_prev, u_cur), (abs_t, rel_t) = scan_layers(
-            (bc, field), u0, u1, 1, nsteps, errors
+        carry, (abs_t, rel_t) = scan_layers(
+            (bc, field), carry0, 1, nsteps, errors
         )
+        u_prev, u_cur = final_state(carry)
         abs_all = jnp.concatenate([jnp.stack([a0, a1]), abs_t])
         rel_all = jnp.concatenate([jnp.stack([r0, r1]), rel_t])
         return u_prev, u_cur, abs_all, rel_all
@@ -467,7 +565,7 @@ def make_sharded_resumer(
         )
     f = stencil_ref.compute_dtype(dtype)
     (sx, sy, sz), bcs, mes, ct = _replicated_inputs(problem, topo, dtype)
-    errors_fn, _, scan_layers = _local_solve_fns(
+    errors_fn, _, scan_layers, _ = _local_solve_fns(
         problem, topo, dtype, compute_errors, kernel, overlap, interpret
     )
 
@@ -478,7 +576,7 @@ def make_sharded_resumer(
         errors = errors_fn(mex, mey, mez, sx, sy, sz, ct)
         bc = bcx[:, None, None] * bcy[None, :, None] * bcz[None, None, :]
         (u_p, u_c), (abs_t, rel_t) = scan_layers(
-            (bc, field), u_prev, u_cur, start_step, nsteps, errors
+            (bc, field), (u_prev, u_cur), start_step, nsteps, errors
         )
         head = jnp.zeros((start_step + 1,), f)
         return (
@@ -562,6 +660,7 @@ def solve_sharded(
     interpret: Optional[bool] = None,
     c2tau2_field: Optional[np.ndarray] = None,
     stop_step: Optional[int] = None,
+    scheme: str = "standard",
 ) -> SolveResult:
     """Compile + run the distributed solve; returns the same SolveResult as
     the single-device path (errors are cross-device maxima).
@@ -581,7 +680,7 @@ def solve_sharded(
     has_field = c2tau2_field is not None
     runner = make_sharded_solver(
         problem, topo, mesh, dtype, compute_errors, kernel, overlap,
-        interpret, has_field, stop_step,
+        interpret, has_field, stop_step, scheme,
     )
     rt_args = ()
     if has_field:
