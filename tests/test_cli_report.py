@@ -92,6 +92,20 @@ def test_cli_pi_literal_and_defaults(tmp_path, capsys):
     assert side["problem"]["timesteps"] == 20
 
 
+def test_cli_profile_trace(tmp_path, capsys):
+    """--profile captures a jax.profiler trace of the solve."""
+    import glob
+
+    trace_dir = str(tmp_path / "trace")
+    rc = cli.main(
+        ["16", "1", "1", "1", "1", "1", "5", "--backend", "single",
+         "--profile", trace_dir, "--out-dir", str(tmp_path)]
+    )
+    assert rc == 0
+    assert "profile trace:" in capsys.readouterr().out
+    assert glob.glob(trace_dir + "/**/*", recursive=True)
+
+
 def test_cli_bad_args(capsys):
     assert cli.main(["16"]) == 2
     assert "usage" in capsys.readouterr().err

@@ -30,9 +30,10 @@ def test_sharded_checkpoint_roundtrip(small_problem, tmp_path):
     shard_files = [f for f in os.listdir(ck) if f.startswith("shard_")]
     assert len(shard_files) == 8
 
-    problem, u_prev, u_cur, step, mesh_shape = (
+    problem, u_prev, u_cur, step, mesh_shape, scheme, aux = (
         checkpoint.load_sharded_checkpoint(ck)
     )
+    assert scheme == "standard" and aux is None
     assert problem == small_problem
     assert step == 5
     assert mesh_shape == (2, 2, 2)
@@ -69,7 +70,7 @@ def test_sharded_checkpoint_bf16(small_problem, tmp_path):
     )
     ck = str(tmp_path / "ckdir")
     checkpoint.save_sharded_checkpoint(ck, half)
-    _, u_prev, u_cur, _, _ = checkpoint.load_sharded_checkpoint(ck)
+    _, u_prev, u_cur, _, _, _, _ = checkpoint.load_sharded_checkpoint(ck)
     assert u_cur.dtype == jnp.bfloat16
     np.testing.assert_array_equal(
         np.asarray(u_cur).view(np.uint16),

@@ -18,6 +18,10 @@ pick at runtime):
   --platform NAME                   jax platform (e.g. cpu); also honors the
                                     JAX_PLATFORMS env var, which this image's
                                     sitecustomize would otherwise override
+  --profile DIR                     capture a jax.profiler device trace of
+                                    the solve into DIR (TensorBoard/xprof
+                                    format) - the deep-dive complement to
+                                    --phase-timing's summary numbers
   --phase-timing                    measure the loop vs ICI-exchange split
                                     (probe programs; see solver/timing.py) and
                                     add it to the report, like the reference's
@@ -27,8 +31,8 @@ pick at runtime):
                                     to the discretization limit (5.7e-6 vs
                                     1.1e-3 L-inf at N=512/1000 on v5e, at
                                     ~12 vs ~20 Gcell/s); f32/f64, single or
-                                    sharded backend (no checkpoint/overlap
-                                    yet)
+                                    sharded backend (checkpointable; no
+                                    --overlap/--phase-timing yet)
   --kernel {auto,roll,pallas}       hot-kernel selection: pallas = the fused
                                     slab kernel (kernels/stencil_pallas.py,
                                     the analog of the reference shipping its
@@ -71,7 +75,7 @@ from wavetpu.core.problem import Problem
 _KNOWN_FLAGS = (
     "backend", "mesh", "dtype", "no-errors", "out-dir", "platform",
     "phase-timing", "stop-step", "save-state", "resume",
-    "kernel", "overlap", "scheme", "distributed",
+    "kernel", "overlap", "scheme", "distributed", "profile",
 )
 _VALUELESS = ("no-errors", "phase-timing", "overlap", "distributed")
 
@@ -133,26 +137,6 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             raise ValueError(
                 f"--scheme must be standard|compensated, got {scheme}"
             )
-        if scheme == "compensated":
-            if flags.get("dtype") == "bf16":
-                raise ValueError("--scheme compensated requires f32/f64")
-            if "resume" in flags or "save-state" in flags:
-                raise ValueError(
-                    "--scheme compensated does not support checkpointing "
-                    "yet (its state is three buffers, not two)"
-                )
-            if "overlap" in flags:
-                raise ValueError(
-                    "--overlap is not available for --scheme compensated yet"
-                )
-            if "phase-timing" in flags:
-                # The probe (solver/timing.py) times the standard step;
-                # reporting its numbers against a compensated solve would
-                # describe a program that never ran.
-                raise ValueError(
-                    "--phase-timing is not available for "
-                    "--scheme compensated yet"
-                )
         if flags.get("backend") == "single" and "mesh" in flags:
             raise ValueError("--mesh contradicts --backend single")
         if flags.get("backend") == "single" and "overlap" in flags:
@@ -201,7 +185,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     return 2
                 # Meta only (numpy): the shard arrays are loaded after the
                 # jax platform is configured below.
-                problem, _start, _ck_mesh, _ck_dtype = (
+                problem, _start, _ck_mesh, _ck_dtype, _ck_scheme = (
                     _ckpt.load_sharded_meta(flags["resume"])
                 )
                 if "mesh" in flags and tuple(
@@ -223,6 +207,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     return 2
                 problem, _u_prev0, _u_cur0, _start = _ckpt.load_checkpoint(
                     flags["resume"]
+                )
+                _ck_scheme = _ckpt.checkpoint_scheme(flags["resume"])
+                _ck_aux = (
+                    _ckpt.load_checkpoint_aux(flags["resume"])
+                    if _ck_scheme == "compensated"
+                    else None
                 )
                 resume_state = (_u_prev0, _u_cur0, _start)
         except Exception as e:
@@ -309,9 +299,48 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     kernel = resolve_kernel(
         flags.get("kernel", "auto"), jax.default_backend()
     )
+    if "resume" in flags:
+        # A checkpoint is resumed under the scheme it was saved with; a
+        # contradicting explicit --scheme is a user error.
+        if "scheme" in flags and scheme != _ck_scheme:
+            print(
+                f"error: checkpoint was saved with scheme {_ck_scheme}; "
+                f"--scheme {scheme} cannot resume it",
+                file=sys.stderr,
+            )
+            return 2
+        scheme = _ck_scheme
+    # Scheme-conditional flag checks run HERE - after a resumed run has
+    # inherited its scheme from the checkpoint - so they also cover
+    # `--resume comp_ck --phase-timing` etc., not just explicit --scheme.
+    if scheme == "compensated":
+        bad = None
+        if flags.get("dtype") == "bf16":
+            bad = "--dtype bf16 (compensated requires f32/f64)"
+        elif "overlap" in flags:
+            bad = "--overlap"
+        elif "phase-timing" in flags:
+            # The probe (solver/timing.py) times the standard step;
+            # reporting its numbers against a compensated solve would
+            # describe a program that never ran.
+            bad = "--phase-timing"
+        if bad:
+            print(
+                f"error: {bad} is not available for the compensated "
+                f"scheme",
+                file=sys.stderr,
+            )
+            return 2
     say(f"kernel: {kernel}")
     say(f"scheme: {scheme}")
     overlap = "overlap" in flags
+
+    profile_dir = flags.get("profile")
+    if profile_dir and is_main:
+        # jax.profiler hook (SURVEY section 5 tracing row): full XLA device
+        # traces; the phase probes give the summary split, this gives the
+        # op-level picture.
+        jax.profiler.start_trace(profile_dir)
 
     if backend == "sharded":
         from wavetpu.solver import sharded
@@ -320,7 +349,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             from wavetpu.io import checkpoint as _ckpt
 
             try:
-                problem, _u_prev0, _u_cur0, _start, _ck_mesh = (
+                (problem, _u_prev0, _u_cur0, _start, _ck_mesh,
+                 _ck_scheme, _ck_aux) = (
                     _ckpt.load_sharded_checkpoint(flags["resume"])
                 )
             except Exception as e:
@@ -334,6 +364,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             resume_dtype = (
                 dtype if "dtype" in flags else jnp.dtype(_u_cur0.dtype)
             )
+            _v, _c = _ck_aux if _ck_aux is not None else (None, None)
             result = sharded.resume_sharded(
                 problem,
                 _u_prev0,
@@ -344,6 +375,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 kernel=kernel,
                 overlap=overlap,
                 compute_errors=compute_errors,
+                scheme=scheme,
+                comp_v=_v,
+                comp_carry=_c,
             )
             shape = _ck_mesh
         else:
@@ -371,7 +405,44 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             from wavetpu.kernels import stencil_pallas
 
             step_fn = stencil_pallas.make_step_fn(interpret=interpret)
-        if scheme == "compensated":
+        if resume_state is not None:
+            u_prev0, u_cur0, start = resume_state
+            # Unless --dtype was given explicitly, resume in the dtype the
+            # checkpoint was saved with - casting would break the
+            # bitwise-equal-resume guarantee (io/checkpoint.py).
+            resume_dtype = (
+                dtype if "dtype" in flags else jnp.dtype(u_cur0.dtype)
+            )
+            if scheme == "compensated":
+                comp_step_fn = None
+                if kernel == "pallas":
+                    from wavetpu.kernels import stencil_pallas as _sp
+
+                    comp_step_fn = _sp.make_compensated_step_fn(
+                        interpret=interpret
+                    )
+                _v, _c = _ck_aux
+                result = leapfrog.resume_compensated(
+                    problem,
+                    u_cur0,
+                    _v,
+                    _c,
+                    start_step=start,
+                    dtype=resume_dtype,
+                    comp_step_fn=comp_step_fn,
+                    compute_errors=compute_errors,
+                )
+            else:
+                result = leapfrog.resume(
+                    problem,
+                    u_prev0,
+                    u_cur0,
+                    start_step=start,
+                    dtype=resume_dtype,
+                    step_fn=step_fn,
+                    compute_errors=compute_errors,
+                )
+        elif scheme == "compensated":
             comp_step_fn = None
             if kernel == "pallas":
                 comp_step_fn = stencil_pallas.make_compensated_step_fn(
@@ -383,23 +454,6 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 comp_step_fn=comp_step_fn,
                 compute_errors=compute_errors,
                 stop_step=stop_step,
-            )
-        elif resume_state is not None:
-            u_prev0, u_cur0, start = resume_state
-            # Unless --dtype was given explicitly, resume in the dtype the
-            # checkpoint was saved with - casting would break the
-            # bitwise-equal-resume guarantee (io/checkpoint.py).
-            resume_dtype = (
-                dtype if "dtype" in flags else jnp.dtype(u_cur0.dtype)
-            )
-            result = leapfrog.resume(
-                problem,
-                u_prev0,
-                u_cur0,
-                start_step=start,
-                dtype=resume_dtype,
-                step_fn=step_fn,
-                compute_errors=compute_errors,
             )
         else:
             result = leapfrog.solve(
@@ -427,6 +481,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             # (concurrent np.savez to one path is not atomic).
             ck_path = _ckpt.save_checkpoint(flags["save-state"], result)
             say(f"checkpoint: {ck_path}")
+
+    if profile_dir and is_main:
+        jax.profiler.stop_trace()
+        say(f"profile trace: {profile_dir}")
 
     exchange_seconds = loop_seconds = None
     probe_steps = None

@@ -74,6 +74,20 @@ def save_checkpoint(path: str, result: SolveResult) -> str:
     )
     u_prev, prev_tag = _encode_field(result.u_prev)
     u_cur, cur_tag = _encode_field(result.u_cur)
+    extra = {}
+    if result.comp_v is not None:
+        # Compensated-scheme state is three buffers: u, the increment v,
+        # and the Kahan carry (u_prev is still stored for uniformity /
+        # inspection, but the bitwise resume re-enters from (u, v, carry)).
+        comp_v, v_tag = _encode_field(result.comp_v)
+        comp_carry, c_tag = _encode_field(result.comp_carry)
+        extra = dict(
+            scheme="compensated",
+            comp_v=comp_v,
+            comp_carry=comp_carry,
+            comp_v_dtype=v_tag,
+            comp_carry_dtype=c_tag,
+        )
     np.savez(
         path,
         format_version=_FORMAT_VERSION,
@@ -82,6 +96,7 @@ def save_checkpoint(path: str, result: SolveResult) -> str:
         u_cur=u_cur,
         u_prev_dtype=prev_tag,
         u_cur_dtype=cur_tag,
+        **extra,
         **{
             f"problem_{k}": v
             for k, v in dataclasses.asdict(p).items()
@@ -174,13 +189,29 @@ def save_sharded_checkpoint(path_dir: str, result: SolveResult) -> str:
     def starts_of(index):
         return tuple(int(sl.start or 0) for sl in index)
 
-    prev_by_start = {
-        starts_of(s.index): s.data for s in u_prev.addressable_shards
-    }
+    compensated = result.comp_v is not None
+
+    def by_start(arr):
+        return {starts_of(s.index): s.data for s in arr.addressable_shards}
+
+    prev_by_start = by_start(u_prev)
+    aux_by_start = (
+        (by_start(result.comp_v), by_start(result.comp_carry))
+        if compensated
+        else None
+    )
     for sc in u_cur.addressable_shards:
         starts = starts_of(sc.index)
         prev_block, prev_tag = _encode_field(prev_by_start[starts])
         cur_block, cur_tag = _encode_field(sc.data)
+        extra = {}
+        if compensated:
+            v_block, v_tag = _encode_field(aux_by_start[0][starts])
+            c_block, c_tag = _encode_field(aux_by_start[1][starts])
+            extra = dict(
+                comp_v=v_block, comp_carry=c_block,
+                comp_v_dtype=v_tag, comp_carry_dtype=c_tag,
+            )
         atomic_savez(
             _shard_filename(starts),
             step=step,
@@ -188,6 +219,7 @@ def save_sharded_checkpoint(path_dir: str, result: SolveResult) -> str:
             u_cur=cur_block,
             u_prev_dtype=prev_tag,
             u_cur_dtype=cur_tag,
+            **extra,
         )
     if jax.process_index() == 0:
         atomic_savez(
@@ -196,6 +228,9 @@ def save_sharded_checkpoint(path_dir: str, result: SolveResult) -> str:
             step=step,
             mesh_shape=np.asarray(mesh_shape),
             state_dtype=np.asarray(u_cur.dtype.name),
+            scheme=np.asarray(
+                "compensated" if compensated else "standard"
+            ),
             **{
                 f"problem_{k}": v
                 for k, v in dataclasses.asdict(p).items()
@@ -223,15 +258,17 @@ def load_sharded_meta(path_dir: str):
         state_dtype = (
             str(z["state_dtype"]) if "state_dtype" in z.files else None
         )
-    return problem, step, mesh_shape, state_dtype
+        scheme = str(z["scheme"]) if "scheme" in z.files else "standard"
+    return problem, step, mesh_shape, state_dtype, scheme
 
 
 def load_sharded_checkpoint(path_dir: str, devices=None):
     """Load a per-shard checkpoint back onto a device mesh.
 
-    Returns (problem, u_prev, u_cur, step, mesh_shape) with u_* global
-    jax.Arrays sharded P("x","y","z") over a mesh rebuilt from the stored
-    shape.  Each process reads only the shard files its devices own
+    Returns (problem, u_prev, u_cur, step, mesh_shape, scheme, aux) with
+    u_* global jax.Arrays sharded P("x","y","z") over a mesh rebuilt from
+    the stored shape; `scheme` is "standard" or "compensated" and `aux` is
+    the compensated (comp_v, comp_carry) pair or None.  Each process reads only the shard files its devices own
     (jax.make_array_from_single_device_arrays), so the load path is as
     multi-host-scalable as the save path.
     """
@@ -243,14 +280,17 @@ def load_sharded_checkpoint(path_dir: str, devices=None):
 
     from wavetpu.core.grid import AXIS_NAMES, Topology, build_mesh
 
-    problem, step, mesh_shape, _ = load_sharded_meta(path_dir)
+    problem, step, mesh_shape, _, scheme = load_sharded_meta(path_dir)
     topo = Topology(N=problem.N, mesh_shape=mesh_shape)
     if devices is None:
         devices = jax.devices()
     mesh = build_mesh(mesh_shape, devices[: topo.n_devices])
     sharding = NamedSharding(mesh, P(*AXIS_NAMES))
     imap = sharding.addressable_devices_indices_map(topo.padded)
-    prevs, curs = [], []
+    compensated = scheme == "compensated"
+    buffers = {"u_prev": [], "u_cur": []}
+    if compensated:
+        buffers.update(comp_v=[], comp_carry=[])
     for dev, idx in imap.items():
         starts = tuple(int(sl.start or 0) for sl in idx)
         with np.load(
@@ -266,23 +306,24 @@ def load_sharded_checkpoint(path_dir: str, devices=None):
             def tag(name):
                 return str(z[name]) if name in z.files else None
 
-            prevs.append(
-                jax.device_put(
-                    _decode_field(z["u_prev"], tag("u_prev_dtype")), dev
+            for key, bufs in buffers.items():
+                bufs.append(
+                    jax.device_put(
+                        _decode_field(z[key], tag(f"{key}_dtype")), dev
+                    )
                 )
-            )
-            curs.append(
-                jax.device_put(
-                    _decode_field(z["u_cur"], tag("u_cur_dtype")), dev
-                )
-            )
-    u_prev = jax.make_array_from_single_device_arrays(
-        topo.padded, sharding, prevs
-    )
-    u_cur = jax.make_array_from_single_device_arrays(
-        topo.padded, sharding, curs
-    )
-    return problem, u_prev, u_cur, step, mesh_shape
+
+    def assemble(bufs):
+        return jax.make_array_from_single_device_arrays(
+            topo.padded, sharding, bufs
+        )
+
+    u_prev = assemble(buffers["u_prev"])
+    u_cur = assemble(buffers["u_cur"])
+    aux = None
+    if compensated:
+        aux = (assemble(buffers["comp_v"]), assemble(buffers["comp_carry"]))
+    return problem, u_prev, u_cur, step, mesh_shape, scheme, aux
 
 
 def resume_sharded_solve(
@@ -293,16 +334,17 @@ def resume_sharded_solve(
     compute_errors: bool = True,
 ) -> SolveResult:
     """Load a per-shard checkpoint and march to problem.timesteps on the
-    mesh it was saved from."""
+    mesh it was saved from, under the scheme it was saved with."""
     from wavetpu.solver import sharded
 
-    problem, u_prev, u_cur, step, mesh_shape = load_sharded_checkpoint(
-        path_dir
+    problem, u_prev, u_cur, step, mesh_shape, scheme, aux = (
+        load_sharded_checkpoint(path_dir)
     )
     if dtype is None:
         import jax.numpy as jnp
 
         dtype = jnp.dtype(u_cur.dtype)
+    comp_v, comp_carry = aux if aux is not None else (None, None)
     return sharded.resume_sharded(
         problem,
         u_prev,
@@ -311,25 +353,77 @@ def resume_sharded_solve(
         mesh_shape=mesh_shape,
         dtype=dtype,
         kernel=kernel,
-        overlap=overlap,
+        overlap=overlap if scheme == "standard" else False,
         compute_errors=compute_errors,
+        scheme=scheme,
+        comp_v=comp_v,
+        comp_carry=comp_carry,
     )
+
+
+def load_checkpoint_aux(path: str):
+    """The compensated-scheme auxiliary state (v, carry) of a single-file
+    checkpoint, or None for a standard-scheme one."""
+    with np.load(path) as z:
+        if "comp_v" not in z.files:
+            return None
+
+        def tag(name):
+            return str(z[name]) if name in z.files else None
+
+        return (
+            _decode_field(z["comp_v"], tag("comp_v_dtype")),
+            _decode_field(z["comp_carry"], tag("comp_carry_dtype")),
+        )
+
+
+def checkpoint_scheme(path: str) -> str:
+    """The time-integration scheme a single-file checkpoint was saved
+    under: "compensated" or "standard" (numpy-only; no jax)."""
+    with np.load(path) as z:
+        return str(z["scheme"]) if "scheme" in z.files else "standard"
 
 
 def resume_solve(
     path: str,
     dtype=None,
     step_fn=None,
+    comp_step_fn=None,
     compute_errors: bool = True,
 ) -> SolveResult:
     """Load a checkpoint and march from its step to `problem.timesteps`.
 
+    Dispatches on the stored scheme: a compensated checkpoint re-enters
+    the compensated scan from (u, v, carry) - `comp_step_fn` then selects
+    its kernel and `step_fn` is ignored (and vice versa for standard).
     `dtype` defaults to the stored arrays' dtype.
     """
+    import jax.numpy as jnp
+
+    if checkpoint_scheme(path) == "compensated":
+        with np.load(path) as z:
+            def tag(name):
+                return str(z[name]) if name in z.files else None
+
+            problem = _problem_from_npz(z)
+            step = int(z["step"])
+            u_cur = _decode_field(z["u_cur"], tag("u_cur_dtype"))
+            v = _decode_field(z["comp_v"], tag("comp_v_dtype"))
+            carry = _decode_field(z["comp_carry"], tag("comp_carry_dtype"))
+        if dtype is None:
+            dtype = jnp.dtype(u_cur.dtype)
+        return leapfrog.resume_compensated(
+            problem,
+            u_cur,
+            v,
+            carry,
+            start_step=step,
+            dtype=dtype,
+            comp_step_fn=comp_step_fn,
+            compute_errors=compute_errors,
+        )
     problem, u_prev, u_cur, step = load_checkpoint(path)
     if dtype is None:
-        import jax.numpy as jnp
-
         dtype = jnp.dtype(u_cur.dtype)
     return leapfrog.resume(
         problem,

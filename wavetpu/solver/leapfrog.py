@@ -44,6 +44,11 @@ class SolveResult:
     solve_seconds: float = 0.0
     steps_computed: Optional[int] = None  # steps THIS run marched (throughput)
     final_step: Optional[int] = None      # layer index u_cur holds (checkpoint)
+    # Compensated-scheme auxiliary state (None on the standard scheme):
+    # the increment buffer v = u_n - u_{n-1} and the Kahan carry at
+    # final_step - what a checkpoint must store for a bitwise resume.
+    comp_v: Optional[jax.Array] = None
+    comp_carry: Optional[jax.Array] = None
 
     @property
     def gcells_per_second(self) -> float:
@@ -361,8 +366,9 @@ def make_compensated_solver(
         rel_all = jnp.concatenate([jnp.stack([r0, r1]), rel_t])
         # u_prev reconstructed from the increment (v = u_n - u_{n-1}
         # exactly in exact arithmetic; here to f32 rounding) so the result
-        # shape matches the standard solver's.
-        return u - v, u, abs_all, rel_all
+        # shape matches the standard solver's; v and carry ride along for
+        # checkpointing.
+        return u - v, u, v, c, abs_all, rel_all
 
     return jax.jit(run)
 
@@ -379,8 +385,8 @@ def solve_compensated(
     runner = make_compensated_solver(
         problem, dtype, comp_step_fn, compute_errors, stop_step
     )
-    (u_prev, u_cur, abs_all, rel_all), init_s, solve_s = _timed_compile_run(
-        runner, (), sync=lambda out: np.asarray(out[2])
+    (u_prev, u_cur, v, carry, abs_all, rel_all), init_s, solve_s = (
+        _timed_compile_run(runner, (), sync=lambda out: np.asarray(out[4]))
     )
     return SolveResult(
         problem=problem,
@@ -392,6 +398,83 @@ def solve_compensated(
         solve_seconds=solve_s,
         steps_computed=stop_step,
         final_step=stop_step if stop_step is not None else problem.timesteps,
+        comp_v=v,
+        comp_carry=carry,
+    )
+
+
+def resume_compensated(
+    problem: Problem,
+    u_cur,
+    v,
+    carry,
+    start_step: int,
+    dtype=jnp.float32,
+    comp_step_fn: Optional[Callable] = None,
+    compute_errors: bool = True,
+) -> SolveResult:
+    """Re-enter the compensated scan at layer `start_step`.
+
+    `(u_cur, v, carry)` is the full compensated state a checkpoint stored
+    (SolveResult.u_cur / .comp_v / .comp_carry of a stopped run); the
+    per-step op sequence equals an uninterrupted run's, so the final state
+    is bitwise-equal (tests/test_compensated.py).
+    """
+    if dtype == jnp.bfloat16:
+        raise ValueError("compensated scheme requires f32/f64 state")
+    step = (
+        comp_step_fn if comp_step_fn is not None
+        else stencil_ref.compensated_step
+    )
+    nsteps = problem.timesteps
+    if not 1 <= start_step <= nsteps:
+        raise ValueError(
+            f"start_step must be in [1, {nsteps}], got {start_step}"
+        )
+    errors = _error_fn(problem, dtype)
+
+    def run(u_cur, v, carry):
+        def body(state, layer):
+            u, vv, cc = state
+            u2, v2, c2 = step(u, vv, cc, problem, None)
+            if compute_errors:
+                ae, re = errors(u2, layer)
+            else:
+                ae = re = jnp.zeros((), dtype)
+            return (u2, v2, c2), (ae, re)
+
+        (u, vv, cc), (abs_t, rel_t) = jax.lax.scan(
+            body, (u_cur, v, carry), jnp.arange(start_step + 1, nsteps + 1)
+        )
+        head = jnp.zeros((start_step + 1,), dtype)
+        return (
+            u - vv, u, vv, cc,
+            jnp.concatenate([head, abs_t]),
+            jnp.concatenate([head, rel_t]),
+        )
+
+    args = (
+        jnp.asarray(u_cur, dtype),
+        jnp.asarray(v, dtype),
+        jnp.asarray(carry, dtype),
+    )
+    (u_prev, u, vv, cc, abs_all, rel_all), init_s, solve_s = (
+        _timed_compile_run(
+            jax.jit(run), args, sync=lambda out: np.asarray(out[4])
+        )
+    )
+    return SolveResult(
+        problem=problem,
+        u_prev=u_prev,
+        u_cur=u,
+        abs_errors=np.asarray(abs_all, dtype=np.float64),
+        rel_errors=np.asarray(rel_all, dtype=np.float64),
+        init_seconds=init_s,
+        solve_seconds=solve_s,
+        steps_computed=nsteps - start_step,
+        final_step=nsteps,
+        comp_v=vv,
+        comp_carry=cc,
     )
 
 

@@ -500,6 +500,8 @@ def make_sharded_solver(
         scheme,
     )
 
+    compensated = scheme == "compensated"
+
     def local_solve(sx, sy, sz, bcx, bcy, bcz, mex, mey, mez, ct, *rest):
         field = rest[0] if has_field else None
         errors = errors_fn(mex, mey, mez, sx, sy, sz, ct)
@@ -512,6 +514,10 @@ def make_sharded_solver(
         u_prev, u_cur = final_state(carry)
         abs_all = jnp.concatenate([jnp.stack([a0, a1]), abs_t])
         rel_all = jnp.concatenate([jnp.stack([r0, r1]), rel_t])
+        if compensated:
+            # v and the Kahan carry ride out for checkpointing.
+            _, v, kc = carry
+            return u_prev, u_cur, abs_all, rel_all, v, kc
         return u_prev, u_cur, abs_all, rel_all
 
     in_specs = [
@@ -522,6 +528,9 @@ def make_sharded_solver(
     ]
     if has_field:
         in_specs.append(P(*AXIS_NAMES))
+    out_specs = [P(*AXIS_NAMES), P(*AXIS_NAMES), P(), P()]
+    if compensated:
+        out_specs += [P(*AXIS_NAMES), P(*AXIS_NAMES)]
     # check_vma=False: the Pallas interpret path (CPU tests/dryruns) does
     # not yet propagate varying-mesh-axes through in-kernel concatenates;
     # parity with the roll kernel is pinned by tests instead.
@@ -529,7 +538,7 @@ def make_sharded_solver(
         local_solve,
         mesh=mesh,
         in_specs=tuple(in_specs),
-        out_specs=(P(*AXIS_NAMES), P(*AXIS_NAMES), P(), P()),
+        out_specs=tuple(out_specs),
         check_vma=False,
     )
 
@@ -550,6 +559,7 @@ def make_sharded_resumer(
     overlap: bool = False,
     interpret: bool = False,
     has_field: bool = False,
+    scheme: str = "standard",
 ):
     """Jitted re-entry into the sharded time loop at layer `start_step`.
 
@@ -565,30 +575,35 @@ def make_sharded_resumer(
         )
     f = stencil_ref.compute_dtype(dtype)
     (sx, sy, sz), bcs, mes, ct = _replicated_inputs(problem, topo, dtype)
-    errors_fn, _, scan_layers, _ = _local_solve_fns(
-        problem, topo, dtype, compute_errors, kernel, overlap, interpret
+    errors_fn, _, scan_layers, final_state = _local_solve_fns(
+        problem, topo, dtype, compute_errors, kernel, overlap, interpret,
+        scheme,
     )
+    compensated = scheme == "compensated"
+    n_state = 3 if compensated else 2
 
-    def local_resume(
-        u_prev, u_cur, sx, sy, sz, bcx, bcy, bcz, mex, mey, mez, ct, *rest
-    ):
+    def local_resume(*args):
+        state = args[:n_state]
+        (sx, sy, sz, bcx, bcy, bcz, mex, mey, mez, ct, *rest) = (
+            args[n_state:]
+        )
         field = rest[0] if has_field else None
         errors = errors_fn(mex, mey, mez, sx, sy, sz, ct)
         bc = bcx[:, None, None] * bcy[None, :, None] * bcz[None, None, :]
-        (u_p, u_c), (abs_t, rel_t) = scan_layers(
-            (bc, field), (u_prev, u_cur), start_step, nsteps, errors
+        carry, (abs_t, rel_t) = scan_layers(
+            (bc, field), state, start_step, nsteps, errors
         )
+        u_p, u_c = final_state(carry)
         head = jnp.zeros((start_step + 1,), f)
-        return (
-            u_p,
-            u_c,
-            jnp.concatenate([head, abs_t]),
-            jnp.concatenate([head, rel_t]),
-        )
+        abs_all = jnp.concatenate([head, abs_t])
+        rel_all = jnp.concatenate([head, rel_t])
+        if compensated:
+            _, v, kc = carry
+            return u_p, u_c, abs_all, rel_all, v, kc
+        return u_p, u_c, abs_all, rel_all
 
     state_spec = P(*AXIS_NAMES)
-    in_specs = [
-        state_spec, state_spec,
+    in_specs = [state_spec] * n_state + [
         P("x"), P("y"), P("z"),
         P("x"), P("y"), P("z"),
         P("x"), P("y"), P("z"),
@@ -596,19 +611,23 @@ def make_sharded_resumer(
     ]
     if has_field:
         in_specs.append(P(*AXIS_NAMES))
+    out_specs = [state_spec, state_spec, P(), P()]
+    if compensated:
+        out_specs += [state_spec, state_spec]
     sharded_fn = jax.shard_map(
         local_resume,
         mesh=mesh,
         in_specs=tuple(in_specs),
-        out_specs=(state_spec, state_spec, P(), P()),
+        out_specs=tuple(out_specs),
         check_vma=False,
     )
 
-    def run(u_prev, u_cur, *rt_args):
-        return sharded_fn(
-            jnp.asarray(u_prev, dtype), jnp.asarray(u_cur, dtype),
-            sx, sy, sz, *bcs, *mes, ct, *rt_args,
+    def run(*state_and_args):
+        state = tuple(
+            jnp.asarray(a, dtype) for a in state_and_args[:n_state]
         )
+        rt_args = state_and_args[n_state:]
+        return sharded_fn(*state, sx, sy, sz, *bcs, *mes, ct, *rt_args)
 
     return jax.jit(run)
 
@@ -620,18 +639,20 @@ def _default_interpret() -> bool:
 
 
 def _run_timed(runner, rt_args):
+    """(outputs, abs_np, rel_np, init_s, solve_s); outputs is the runner's
+    tuple (u_prev, u_cur, abs, rel[, v, carry])."""
     t0 = time.perf_counter()
     compiled = runner.lower(*rt_args).compile()
     t1 = time.perf_counter()
-    u_prev, u_cur, abs_all, rel_all = compiled(*rt_args)
-    jax.block_until_ready((u_prev, u_cur, abs_all, rel_all))
+    out = compiled(*rt_args)
+    jax.block_until_ready(out)
     # The small error-vector readback inside the timed region proves the
     # program actually ran: on remote backends block_until_ready can return
     # before execution (see leapfrog._timed_compile_run).
-    abs_np = np.asarray(abs_all, dtype=np.float64)
-    rel_np = np.asarray(rel_all, dtype=np.float64)
+    abs_np = np.asarray(out[2], dtype=np.float64)
+    rel_np = np.asarray(out[3], dtype=np.float64)
     t2 = time.perf_counter()
-    return u_prev, u_cur, abs_np, rel_np, t1 - t0, t2 - t1
+    return out, abs_np, rel_np, t1 - t0, t2 - t1
 
 
 def _resolve_mesh(problem, mesh_shape, devices):
@@ -686,17 +707,19 @@ def solve_sharded(
     if has_field:
         f = stencil_ref.compute_dtype(dtype)
         rt_args = (jnp.asarray(pad_field(c2tau2_field, topo), dtype=f),)
-    u_prev, u_cur, abs_np, rel_np, init_s, solve_s = _run_timed(runner, rt_args)
+    out, abs_np, rel_np, init_s, solve_s = _run_timed(runner, rt_args)
     return SolveResult(
         problem=problem,
-        u_prev=u_prev,
-        u_cur=u_cur,
+        u_prev=out[0],
+        u_cur=out[1],
         abs_errors=abs_np,
         rel_errors=rel_np,
         init_seconds=init_s,
         solve_seconds=solve_s,
         steps_computed=stop_step,
         final_step=stop_step if stop_step is not None else problem.timesteps,
+        comp_v=out[4] if scheme == "compensated" else None,
+        comp_carry=out[5] if scheme == "compensated" else None,
     )
 
 
@@ -713,35 +736,50 @@ def resume_sharded(
     overlap: bool = False,
     interpret: Optional[bool] = None,
     c2tau2_field: Optional[np.ndarray] = None,
+    scheme: str = "standard",
+    comp_v=None,
+    comp_carry=None,
 ) -> SolveResult:
     """Re-enter the sharded time loop at layer `start_step` and run to the
     end.  `u_prev`/`u_cur` are padded (topo.padded) arrays - what
-    `solve_sharded(stop_step=...)` returned and io/checkpoint.py stored."""
+    `solve_sharded(stop_step=...)` returned and io/checkpoint.py stored.
+    A compensated resume additionally takes (comp_v, comp_carry) and
+    re-enters from (u_cur, v, carry); u_prev is then ignored."""
     topo, mesh = _resolve_mesh(problem, mesh_shape, devices)
     if interpret is None:
         interpret = _default_interpret()
     has_field = c2tau2_field is not None
+    compensated = scheme == "compensated"
+    if compensated and (comp_v is None or comp_carry is None):
+        raise ValueError(
+            "compensated resume needs comp_v and comp_carry"
+        )
     runner = make_sharded_resumer(
         problem, topo, mesh, start_step, dtype, compute_errors, kernel,
-        overlap, interpret, has_field,
+        overlap, interpret, has_field, scheme,
     )
-    rt_args = (u_prev, u_cur)
+    if compensated:
+        rt_args = (u_cur, comp_v, comp_carry)
+    else:
+        rt_args = (u_prev, u_cur)
     if has_field:
         f = stencil_ref.compute_dtype(dtype)
         rt_args = rt_args + (
             jnp.asarray(pad_field(c2tau2_field, topo), dtype=f),
         )
-    u_p, u_c, abs_np, rel_np, init_s, solve_s = _run_timed(runner, rt_args)
+    out, abs_np, rel_np, init_s, solve_s = _run_timed(runner, rt_args)
     return SolveResult(
         problem=problem,
-        u_prev=u_p,
-        u_cur=u_c,
+        u_prev=out[0],
+        u_cur=out[1],
         abs_errors=abs_np,
         rel_errors=rel_np,
         init_seconds=init_s,
         solve_seconds=solve_s,
         steps_computed=problem.timesteps - start_step,
         final_step=problem.timesteps,
+        comp_v=out[4] if compensated else None,
+        comp_carry=out[5] if compensated else None,
     )
 
 
