@@ -1,16 +1,17 @@
 """Driver benchmark: one JSON line on stdout, run on the real TPU chip.
 
 Headline config follows BASELINE.md's primary metric: N=512, 1000 steps,
-f32 state, fused Pallas kernel, fused analytic-error oracle ON (the
-reference always self-validates, mpi_new.cpp:340-344, so the honest number
-includes it).
+f32 state, k=4 temporally fused Pallas kernel (solver/kfused.py), fused
+analytic-error oracle ON for every layer (the reference always
+self-validates, mpi_new.cpp:340-344, so the honest number includes it).
 
 The single line also carries `sub_benchmarks` so every README claim is
-driver-captured (round-3 verdict, item 9): the bf16-state kernel, the
-jnp-roll XLA path, the sharded backend running the Pallas kernel through
-ppermute'd halos (mesh (1,1,1) on this one-chip image), and the
-compensated-scheme accuracy run (whose max_abs_error is the BASELINE
-accuracy gate: ~4e-6 discretization bound at this config).
+driver-captured (round-3 verdict, item 9): the 1-step Pallas kernel, k=2
+fusion, the bf16-state kernels, the jnp-roll XLA path, the sharded backend
+running the Pallas kernel through ppermute'd halos (mesh (1,1,1) on this
+one-chip image), and the compensated-scheme accuracy run (whose
+max_abs_error is the BASELINE accuracy gate: ~4e-6 discretization bound at
+this config).
 
 Throughput definition (pinned; ADVICE r1): cell updates per step are
 (N+1)^3 - the reference's grid-point count - times `timesteps` steps,
@@ -48,32 +49,50 @@ def main() -> int:
 
     from wavetpu.core.problem import Problem
     from wavetpu.kernels import stencil_pallas
-    from wavetpu.solver import leapfrog, sharded
+    from wavetpu.solver import kfused, leapfrog, sharded
 
     dev = jax.devices()[0]
     n = 512
     steps = 1000
     problem = Problem(N=n, timesteps=steps)
-    backend = "pallas-fused"
+    on_tpu = jax.default_backend() == "tpu"
+    backend = "pallas k=4 fused"
     try:
-        res = leapfrog.solve(
-            problem, step_fn=stencil_pallas.make_step_fn()
-        )  # f32, fused errors
+        res = kfused.solve_kfused(problem, k=4)  # f32, per-layer errors on
     except Exception:
         # CPU-only environments (no Mosaic): fall back to the XLA path so
         # the driver always captures a number.  The reason is printed to
         # stderr so a Pallas regression on real hardware is not silent.
         import traceback
 
-        print("pallas path failed, falling back to jnp-roll:", file=sys.stderr)
+        print("k-fused path failed, falling back to jnp-roll:",
+              file=sys.stderr)
         traceback.print_exc()
         backend = "jnp-roll"
         res = leapfrog.solve(problem)
 
-    on_tpu = jax.default_backend() == "tpu"
     subs = {
-        "bf16_pallas": _run(
-            "bf16_pallas",
+        "pallas_1step_f32": _run(
+            "pallas_1step_f32",
+            lambda: leapfrog.solve(
+                problem, step_fn=stencil_pallas.make_step_fn(
+                    interpret=not on_tpu)
+            ),
+        ),
+        "kfused_k2_f32": _run(
+            "kfused_k2_f32",
+            lambda: kfused.solve_kfused(
+                problem, k=2, interpret=not on_tpu
+            ),
+        ),
+        "kfused_k4_bf16": _run(
+            "kfused_k4_bf16",
+            lambda: kfused.solve_kfused(
+                problem, dtype=jnp.bfloat16, k=4, interpret=not on_tpu
+            ),
+        ),
+        "bf16_pallas_1step": _run(
+            "bf16_pallas_1step",
             lambda: leapfrog.solve(
                 problem,
                 dtype=jnp.bfloat16,

@@ -143,3 +143,79 @@ def test_cli_preemption_workflow(tmp_path, capsys):
     full = json.load(open(os.path.join(full_dir, "output_N16_Np1_TPU.json")))
     res = json.load(open(os.path.join(res_dir, "output_N16_Np1_TPU.json")))
     assert res["abs_errors"][7:] == full["abs_errors"][7:]
+
+
+def test_cli_fuse_steps(tmp_path, capsys):
+    """--fuse-steps selects the k-fused pallas path; report errors match
+    the 1-step run's (bitwise-identical layers, solver/kfused.py)."""
+    base = ["16", "1", "1", "1", "1", "1", "9"]
+    one_dir, k_dir = str(tmp_path / "one"), str(tmp_path / "k")
+    assert cli.main(
+        base + ["--backend", "single", "--kernel", "pallas",
+                "--out-dir", one_dir]
+    ) == 0
+    assert cli.main(base + ["--fuse-steps", "4", "--out-dir", k_dir]) == 0
+    out = capsys.readouterr().out
+    assert "fuse-steps: 4" in out
+    one = json.load(open(os.path.join(one_dir, "output_N16_Np1_TPU.json")))
+    kf = json.load(open(os.path.join(k_dir, "output_N16_Np1_TPU.json")))
+    # identical layers; the two error-oracle formulations differ only in
+    # f32 multiply order (in-kernel sxct*syz vs post-hoc ((sx*sy)*sz)*ct)
+    assert kf["abs_errors"] == pytest.approx(one["abs_errors"], rel=1e-5)
+
+
+def test_cli_fuse_steps_validation(capsys):
+    base = ["16", "1", "1", "1", "1", "1", "5"]
+    assert cli.main(base + ["--fuse-steps", "4", "--kernel", "roll"]) == 2
+    assert cli.main(base + ["--fuse-steps", "4", "--mesh", "2,2,2"]) == 2
+    assert cli.main(
+        base + ["--fuse-steps", "4", "--scheme", "compensated"]
+    ) == 2
+    assert cli.main(base + ["--fuse-steps", "4", "--phase-timing"]) == 2
+    assert cli.main(["18", "1", "1", "1", "1", "1", "5",
+                     "--fuse-steps", "4"]) == 2  # 4 does not divide 18
+    capsys.readouterr()
+
+
+def test_cli_fuse_steps_resume_guards(tmp_path, capsys):
+    """--fuse-steps must not silently bypass resume semantics: a sharded
+    checkpoint directory is rejected, and a compensated checkpoint (whose
+    scheme is inherited AFTER flag validation) is rejected too."""
+    base = ["16", "1", "1", "1", "1", "1", "8"]
+    shard_ck = str(tmp_path / "shard_ck")
+    assert cli.main(
+        base + ["--mesh", "1,1,1", "--stop-step", "3",
+                "--save-state", shard_ck, "--out-dir", str(tmp_path)]
+    ) == 0
+    assert cli.main(["--resume", shard_ck, "--fuse-steps", "4"]) == 2
+    comp_ck = str(tmp_path / "comp.npz")
+    assert cli.main(
+        base + ["--backend", "single", "--scheme", "compensated",
+                "--stop-step", "3", "--save-state", comp_ck,
+                "--out-dir", str(tmp_path)]
+    ) == 0
+    assert cli.main(["--resume", comp_ck, "--fuse-steps", "4"]) == 2
+    err = capsys.readouterr().err
+    assert "per-shard" in err and "compensated" in err
+
+
+def test_cli_fuse_steps_resume_continues(tmp_path, capsys):
+    """A single-device standard checkpoint resumes through resume_kfused:
+    the error tail matches the uninterrupted run (not a silent restart)."""
+    base = ["16", "1", "1", "1", "1", "1", "10", "--backend", "single",
+            "--kernel", "pallas"]
+    full_dir, res_dir = str(tmp_path / "full"), str(tmp_path / "res")
+    ck = str(tmp_path / "ck.npz")
+    assert cli.main(base + ["--out-dir", full_dir]) == 0
+    assert cli.main(
+        base + ["--out-dir", str(tmp_path), "--stop-step", "6",
+                "--save-state", ck]
+    ) == 0
+    assert cli.main(
+        ["--resume", ck, "--fuse-steps", "4", "--out-dir", res_dir]
+    ) == 0
+    capsys.readouterr()
+    full = json.load(open(os.path.join(full_dir, "output_N16_Np1_TPU.json")))
+    res = json.load(open(os.path.join(res_dir, "output_N16_Np1_TPU.json")))
+    assert res["abs_errors"][7:] == full["abs_errors"][7:]
+    assert all(e == 0 for e in res["abs_errors"][:7])

@@ -608,6 +608,197 @@ def make_compensated_step_fn(block_x=None, interpret=False):
     return step
 
 
+# --------------------------------------------------------------------------
+# Temporally fused k-step kernel.
+#
+# The 1-step kernel above is HBM-streaming-bound: one step reads u_prev + u
+# and writes u_next (~1.75 GB at N=512 f32), and measured pure-copy pallas
+# pipelines on this v5e sustain only ~250 GB/s, so ~7 ms/step is the wall
+# for ANY 1-step formulation (measured: the jnp-roll step, the fused kernel,
+# and a bare out=2u-uprev axpy all land within 15% of it).  The classical
+# stencil answer is temporal blocking: march k substeps per HBM pass on a
+# slab "onion" held in VMEM, reading k-plane halos and writing only the last
+# two layers - traffic per step drops from 3 field-streams to (2 + 2 + 4k/bx)
+# / k.  Measured on v5e at N=512/1000 steps, per-layer errors on:
+# 20.3 Gcell/s (k=1) -> 35.8 (k=2, bx=8) -> 43.8 (k=4, bx=4).
+#
+# The reference has no analog (its CUDA kernel is one-layer-per-launch,
+# cuda_sol_kernels.cu:24-47, with a device-wide sync between layers); this
+# is a TPU-first redesign enabled by the 128 MB VMEM and the sequential
+# pallas grid.
+#
+# Per-layer L-inf errors stay EXACTLY as observable as the reference's
+# (mpi_new.cpp:335-345) even though intermediate layers never reach HBM:
+# the analytic solution is separable (verify/oracle.py), so
+#   abs_layer = max_x [ max_{y,z} |u - sxct[x]*syz| ]          (x != 0)
+#   rel_layer = max_x [ max_{y,z} |u - f| / |syz| ] / |sx[x]*ct|
+# and the kernel only needs per-x-plane maxes of diff and diff/|syz| -
+# two SMEM scalar rows per substep, the tiny per-plane rescale happens
+# outside.  (1/|syz| rides in as a precomputed plane with 0 at syz==0:
+# those cells have u = f = 0 exactly, contributing 0 like the reference's
+# NaN-skip, oracle.layer_errors.)
+# --------------------------------------------------------------------------
+
+_KSTEP_VMEM_LIMIT = 127 * 1024 * 1024
+_KSTEP_VMEM_BUDGET = 122 * 1024 * 1024
+
+
+def choose_kstep_block(n: int, k: int, itemsize: int = 4) -> Optional[int]:
+    """Largest slab depth bx (multiple of k, power-of-two steps, <= 8,
+    dividing n) whose k-step pipeline fits VMEM; None if even bx=k does not.
+
+    Working-set model (validated against Mosaic's scoped-vmem accounting at
+    N=512: est 120 MB vs actual 114 MB for k=2/bx=8): the double-buffered
+    pipeline holds 2 state slabs in + 4 k-plane halos + 2 slabs out, the
+    kernel body another ~3 onion-sized f32 temporaries, plus the two
+    (N,N) oracle planes.
+    """
+    pb_state = n * n * itemsize
+    pb_f32 = n * n * 4
+    best = None
+    bx = k
+    while bx <= 8:
+        if n % bx == 0:
+            pipeline = 2 * (4 * bx + 4 * k) * pb_state
+            planes = 4 * pb_f32
+            temps = 3 * (bx + 2 * k) * pb_f32
+            if pipeline + planes + temps <= _KSTEP_VMEM_BUDGET:
+                best = bx
+        bx *= 2
+    return best
+
+
+def _kstep_kernel(sxct_ref, uprev_ref, uc_ref, plo_ref, phi_ref, lo_ref,
+                  hi_ref, syz_ref, rsyz_ref, *out_refs,
+                  k, bx, coeff, inv_h2, compute_dtype, with_errors):
+    """March k leapfrog substeps on a slab onion held in VMEM.
+
+    The prev/cur onions start at bx+2k planes (slab + k-plane wraparound
+    halos, periodic x) and shrink by one plane per side per substep -
+    after k substeps exactly the central slab remains.  Each substep is
+    op-for-op the 1-step `_step_kernel` update (same laplacian summation
+    order, same fused y/z Dirichlet mask), so a k-fused solve is bitwise
+    identical to the 1-step pallas solve and the two can be mixed freely
+    across checkpoint/resume boundaries (tests/test_kfused.py).
+
+    With `with_errors`, per-substep per-x-plane error maxes are stored as
+    SMEM scalars (see the section comment for the factorization).
+    """
+    if with_errors:
+        out_prev_ref, out_ref, dmax_ref, rmax_ref = out_refs
+    else:
+        out_prev_ref, out_ref = out_refs
+    i = pl.program_id(0)
+    f = compute_dtype
+    ix, iy, iz = (jnp.asarray(v, f) for v in inv_h2)
+    prev = jnp.concatenate(
+        [plo_ref[:].astype(f), uprev_ref[:].astype(f), phi_ref[:].astype(f)],
+        0)
+    cur = jnp.concatenate(
+        [lo_ref[:].astype(f), uc_ref[:].astype(f), hi_ref[:].astype(f)], 0)
+    syz = syz_ref[:]
+    rsyz = rsyz_ref[:]
+    ny, nz = syz.shape
+
+    ym = lax.broadcasted_iota(jnp.int32, (1, ny, nz), 1) != 0
+    zm = lax.broadcasted_iota(jnp.int32, (1, ny, nz), 2) != 0
+    mask = ym & zm
+
+    for s in range(1, k + 1):
+        c = cur[1:-1]
+        lap = (cur[:-2] + cur[2:] - 2.0 * c) * ix
+        lap = lap + (
+            pltpu.roll(c, 1, 1) + pltpu.roll(c, ny - 1, 1) - 2.0 * c
+        ) * iy
+        lap = lap + (
+            pltpu.roll(c, 1, 2) + pltpu.roll(c, nz - 1, 2) - 2.0 * c
+        ) * iz
+        new = 2.0 * c + jnp.asarray(coeff, f) * lap - prev[1:-1]
+        new = jnp.where(mask, new, jnp.asarray(0.0, f))
+        if out_ref.dtype != f:
+            # A narrower state dtype (bf16) quantizes every stored layer on
+            # the 1-step path; round-trip each substep so the k-fused
+            # dynamics (and the observed errors) stay bitwise identical.
+            new = new.astype(out_ref.dtype).astype(f)
+        if with_errors:
+            # Central bx planes of substep s sit at onion offset k - s.
+            ctr = new[k - s: k - s + bx]
+            for j in range(bx):
+                diff = jnp.abs(ctr[j] - sxct_ref[s - 1, i * bx + j] * syz)
+                dmax_ref[s - 1, i * bx + j] = jnp.max(diff)
+                rmax_ref[s - 1, i * bx + j] = jnp.max(diff * rsyz)
+        prev, cur = c, new
+
+    out_prev_ref[:] = prev.astype(out_prev_ref.dtype)
+    out_ref[:] = cur.astype(out_ref.dtype)
+
+
+def fused_kstep(u_prev, u, syz, rsyz, sxct, *, k, coeff, inv_h2,
+                block_x=None, interpret=False, with_errors=True,
+                compute_dtype=None):
+    """k temporally fused leapfrog steps of the full (N,N,N) state.
+
+    Returns `(u_{n+k-1}, u_{n+k}, dmax, rmax)` where dmax/rmax are (k, N)
+    per-substep per-x-plane error maxes (None, None without `with_errors`).
+    `syz`/`rsyz` are the (N, N) oracle planes sy*sz and 1/|sy*sz| (0 at 0);
+    `sxct` the (k, N) per-substep sx*ct row (any (k, N) f32 array when
+    errors are off).  Requires N % k == 0 (wraparound halo blocks).
+    """
+    n = u.shape[0]
+    if compute_dtype is None:
+        compute_dtype = stencil_ref.compute_dtype(u.dtype)
+    if n % k:
+        raise ValueError(f"k={k} must divide N={n}")
+    bx = block_x or choose_kstep_block(n, k, u.dtype.itemsize)
+    if bx is None:
+        raise ValueError(
+            f"k={k} does not fit VMEM at N={n} (choose_kstep_block)"
+        )
+    if n % bx or bx % k:
+        raise ValueError(f"block_x={bx} must divide N={n} and be a "
+                         f"multiple of k={k}")
+    slab = pl.BlockSpec((bx, n, n), lambda i: (i, 0, 0),
+                        memory_space=pltpu.VMEM)
+    # k-plane wraparound halos, indexed in units of k planes: the lower
+    # halo starts at plane i*bx - k = k*(i*bx/k - 1), the upper at
+    # (i+1)*bx; both divisible by k because k | bx.
+    nb = n // k
+    lo = pl.BlockSpec((k, n, n),
+                      lambda i, _bk=bx // k, _nb=nb:
+                      ((i * _bk - 1) % _nb, 0, 0),
+                      memory_space=pltpu.VMEM)
+    hi = pl.BlockSpec((k, n, n),
+                      lambda i, _bk=bx // k, _nb=nb:
+                      (((i + 1) * _bk) % _nb, 0, 0),
+                      memory_space=pltpu.VMEM)
+    plane = pl.BlockSpec((n, n), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    kern = functools.partial(
+        _kstep_kernel, k=k, bx=bx, coeff=coeff, inv_h2=inv_h2,
+        compute_dtype=compute_dtype, with_errors=with_errors,
+    )
+    state = jax.ShapeDtypeStruct(u.shape, u.dtype)
+    out_specs = [slab, slab]
+    out_shape = [state, state]
+    if with_errors:
+        out_specs += [smem, smem]
+        out_shape += [jax.ShapeDtypeStruct((k, n), jnp.float32)] * 2
+    out = pl.pallas_call(
+        kern,
+        grid=(n // bx,),
+        in_specs=[smem, slab, slab, lo, hi, lo, hi, plane, plane],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_KSTEP_VMEM_LIMIT
+        ),
+        interpret=interpret,
+    )(sxct, u_prev, u, u_prev, u_prev, u, u, syz, rsyz)
+    if with_errors:
+        return out
+    return out[0], out[1], None, None
+
+
 def make_step_fn(block_x=None, interpret=False, c2tau2_field=None):
     """A `(u_prev, u, problem) -> u_next` closure for `make_solver(step_fn=)`
     with the kernel tuning parameters bound.

@@ -1,0 +1,276 @@
+"""Temporally fused k-step solver (single device).
+
+Drives `stencil_pallas.fused_kstep`: the time loop scans over BLOCKS of k
+leapfrog layers, each block one pallas call that keeps the intermediate
+layers in VMEM and writes only the block's last two layers to HBM - the
+1-step path's ~3 HBM field-streams per step become (4 + 4k/bx)/k.  Measured
+on a single v5e at the flagship N=512/1000-step config with per-layer
+errors on: 20.3 Gcell/s (1-step kernel) -> 43.8 Gcell/s (k=4).
+
+Per-layer L-inf abs/rel errors remain reported for EVERY layer - the
+kernel emits per-x-plane maxes for the in-VMEM intermediate layers (the
+separable-oracle factorization, stencil_pallas.py section comment), and
+this module applies the tiny per-plane rescales and the x!=0 interior
+mask outside (reference error contract: mpi_new.cpp:335-345,
+openmp_sol.cpp:169-190).
+
+Each substep is op-for-op the 1-step pallas kernel's update, so k-fused
+layers are bitwise identical to 1-step pallas layers: a solve may stop at
+any layer (`stop_step`), checkpoint, and resume with either path
+(tests/test_kfused.py pins this).
+
+The reference has no counterpart to fuse-k (every variant launches one
+kernel per layer with a global sync between); SURVEY.md section 7's perf
+plan called the HBM stream count the budget to beat, and this is the
+mechanism that beats it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from wavetpu.core.problem import Problem
+from wavetpu.kernels import stencil_pallas, stencil_ref
+from wavetpu.solver import leapfrog
+from wavetpu.verify import oracle
+
+
+def _oracle_parts(problem: Problem, f_dtype):
+    """Precomputed separable-oracle pieces for the in-kernel error path.
+
+    syz / rsyz are the (N, N) planes sy*sz and 1/|sy*sz| (exact-zero cells
+    -> 0: there u = f = 0 and the reference's NaN-skip reports 0,
+    oracle.layer_errors).  inv_absx is the per-x-plane rescale 1/|sx| with
+    the x=0 interior exclusion and exact zeros folded in.
+    """
+    sx, sy, sz = oracle.spatial_factors(problem, f_dtype)
+    ct = oracle.time_factor_table(problem, f_dtype)
+    syz = sy[:, None] * sz[None, :]
+    rsyz = jnp.where(
+        syz == 0, jnp.asarray(0, f_dtype),
+        1.0 / jnp.where(syz == 0, jnp.asarray(1, f_dtype), syz),
+    )
+    rsyz = jnp.abs(rsyz)
+    absx = jnp.abs(sx)
+    xmask = jnp.asarray(np.arange(problem.N) != 0)
+    inv_absx = jnp.where(
+        xmask & (absx != 0),
+        1.0 / jnp.where(absx == 0, jnp.asarray(1, f_dtype), absx),
+        jnp.asarray(0, f_dtype),
+    )
+    return sx, ct, syz, rsyz, xmask, inv_absx
+
+
+def _block_errors(dmax, rmax, ctk, xmask, inv_absx):
+    """(k,) abs / rel layer errors from the kernel's (k, N) plane maxes."""
+    abs_e = jnp.max(jnp.where(xmask[None, :], dmax, 0.0), axis=1)
+    rel_e = jnp.max(
+        jnp.where(xmask[None, :], rmax * inv_absx[None, :], 0.0), axis=1
+    )
+    ictk = jnp.abs(ctk)
+    rel_e = jnp.where(
+        ictk != 0, rel_e / jnp.where(ictk == 0, 1.0, ictk), 0.0
+    )
+    return abs_e, rel_e
+
+
+def _validate(problem: Problem, k: int):
+    if k < 2:
+        raise ValueError(f"k must be >= 2 (got {k}); use leapfrog.solve "
+                         "with the pallas step for k=1")
+    if problem.N % k:
+        raise ValueError(f"k={k} must divide N={problem.N}")
+
+
+def _make_march(problem, dtype, k, compute_errors, block_x, interpret,
+                nsteps):
+    """Shared march: k-fused blocks + a 1-step remainder tail.
+
+    Both `make_kfused_solver` and `resume_kfused` MUST use this single
+    implementation - the bitwise-equal-resume guarantee rests on every
+    path emitting the identical per-layer op sequence (the same reasoning
+    as leapfrog._scan_layers being shared).
+
+    Returns `march(u_prev, u_cur, start)` -> (u_prev, u_cur, abs, rel)
+    covering layers start+1..nsteps (`start` must be a Python int).
+    """
+    f = stencil_ref.compute_dtype(dtype)
+    sx, ct, syz, rsyz, xmask, inv_absx = _oracle_parts(problem, f)
+    errors = leapfrog._error_fn(problem, dtype)
+    step1 = stencil_pallas.make_step_fn(interpret=interpret)
+
+    def kblock(carry, nstart):
+        u_prev, u = carry
+        ctk = lax.dynamic_slice(ct, (nstart + 1,), (k,))
+        sxct = ctk[:, None] * sx[None, :]
+        up, uc, dmax, rmax = stencil_pallas.fused_kstep(
+            u_prev, u, syz, rsyz, sxct,
+            k=k, coeff=problem.a2tau2, inv_h2=problem.inv_h2,
+            block_x=block_x, interpret=interpret,
+            with_errors=compute_errors,
+        )
+        if compute_errors:
+            abs_e, rel_e = _block_errors(dmax, rmax, ctk, xmask, inv_absx)
+        else:
+            abs_e = rel_e = jnp.zeros((k,), f)
+        return (up, uc), (abs_e, rel_e)
+
+    def march(u_prev, u_cur, start):
+        nblocks = (nsteps - start) // k
+        rem = (nsteps - start) - nblocks * k
+        starts = start + k * jnp.arange(nblocks)
+        (u_prev, u_cur), (abs_b, rel_b) = lax.scan(
+            kblock, (u_prev, u_cur), starts
+        )
+        abs_parts = [abs_b.reshape(-1)]
+        rel_parts = [rel_b.reshape(-1)]
+        if rem:
+            step, params = leapfrog._as_param_step(step1)
+            (u_prev, u_cur), (ra, rr) = leapfrog._scan_layers(
+                problem, step, params, errors, compute_errors, dtype,
+                u_prev, u_cur, nsteps - rem, nsteps,
+            )
+            abs_parts.append(ra)
+            rel_parts.append(rr)
+        return u_prev, u_cur, jnp.concatenate(abs_parts), jnp.concatenate(
+            rel_parts)
+
+    return march, step1, errors
+
+
+def make_kfused_solver(
+    problem: Problem,
+    dtype=jnp.float32,
+    k: int = 4,
+    compute_errors: bool = True,
+    stop_step: Optional[int] = None,
+    block_x: Optional[int] = None,
+    interpret: bool = False,
+):
+    """Build the jitted k-fused solver; returns a zero-arg runner.
+
+    Layers 0/1 bootstrap exactly as `leapfrog.make_solver` with the pallas
+    1-step kernel; then (nsteps-1)//k fused blocks; a remainder of
+    (nsteps-1) % k layers runs the 1-step kernel (same ops, so the tail is
+    seamless).  Requires k >= 2 and N % k == 0.
+    """
+    _validate(problem, k)
+    nsteps = problem.timesteps if stop_step is None else stop_step
+    if not 1 <= nsteps <= problem.timesteps:
+        raise ValueError(
+            f"stop_step must be in [1, {problem.timesteps}], got {nsteps}"
+        )
+    f = stencil_ref.compute_dtype(dtype)
+    march, step1, errors = _make_march(
+        problem, dtype, k, compute_errors, block_x, interpret, nsteps
+    )
+
+    def run():
+        u0 = leapfrog.initial_layer0(problem, dtype)
+        u1 = (0.5 * (u0.astype(f) + step1(u0, u0, problem).astype(f))
+              ).astype(dtype)
+        a0 = r0 = jnp.zeros((), f)
+        if compute_errors:
+            a1, r1 = errors(u1, 1)
+        else:
+            a1 = r1 = jnp.zeros((), f)
+        u_prev, u_cur, abs_t, rel_t = march(u0, u1, 1)
+        abs_all = jnp.concatenate([jnp.stack([a0, a1]), abs_t])
+        rel_all = jnp.concatenate([jnp.stack([r0, r1]), rel_t])
+        return u_prev, u_cur, abs_all, rel_all
+
+    return jax.jit(run)
+
+
+def solve_kfused(
+    problem: Problem,
+    dtype=jnp.float32,
+    k: int = 4,
+    compute_errors: bool = True,
+    stop_step: Optional[int] = None,
+    block_x: Optional[int] = None,
+    interpret: bool = False,
+) -> leapfrog.SolveResult:
+    """Compile + run the k-fused solve (reference timing phases as
+    `leapfrog.solve`)."""
+    runner = make_kfused_solver(
+        problem, dtype, k, compute_errors, stop_step, block_x, interpret
+    )
+    (u_prev, u_cur, abs_all, rel_all), init_s, solve_s = (
+        leapfrog._timed_compile_run(
+            runner, (), sync=lambda out: np.asarray(out[2])
+        )
+    )
+    return leapfrog.SolveResult(
+        problem=problem,
+        u_prev=u_prev,
+        u_cur=u_cur,
+        abs_errors=np.asarray(abs_all, dtype=np.float64),
+        rel_errors=np.asarray(rel_all, dtype=np.float64),
+        init_seconds=init_s,
+        solve_seconds=solve_s,
+        steps_computed=stop_step,
+        final_step=stop_step if stop_step is not None else problem.timesteps,
+    )
+
+
+def resume_kfused(
+    problem: Problem,
+    u_prev,
+    u_cur,
+    start_step: int,
+    dtype=jnp.float32,
+    k: int = 4,
+    compute_errors: bool = True,
+    block_x: Optional[int] = None,
+    interpret: bool = False,
+) -> leapfrog.SolveResult:
+    """Re-enter the k-fused march at layer `start_step`.
+
+    Because every k-fused substep is op-identical to the 1-step pallas
+    kernel's step, a checkpoint written by either path resumes bitwise-
+    equal under either path (error arrays cover start_step+1..timesteps,
+    earlier entries zero, as `leapfrog.resume`).
+    """
+    _validate(problem, k)
+    nsteps = problem.timesteps
+    if not 1 <= start_step <= nsteps:
+        raise ValueError(
+            f"start_step must be in [1, {nsteps}], got {start_step}"
+        )
+    f = stencil_ref.compute_dtype(dtype)
+    march, _, _ = _make_march(
+        problem, dtype, k, compute_errors, block_x, interpret, nsteps
+    )
+
+    def run(u_prev, u_cur):
+        u_prev, u_cur, abs_t, rel_t = march(u_prev, u_cur, start_step)
+        head = jnp.zeros((start_step + 1,), f)
+        return (
+            u_prev, u_cur,
+            jnp.concatenate([head, abs_t]),
+            jnp.concatenate([head, rel_t]),
+        )
+
+    args = (jnp.asarray(u_prev, dtype), jnp.asarray(u_cur, dtype))
+    (u_p, u_c, abs_all, rel_all), init_s, solve_s = (
+        leapfrog._timed_compile_run(
+            jax.jit(run), args, sync=lambda out: np.asarray(out[2])
+        )
+    )
+    return leapfrog.SolveResult(
+        problem=problem,
+        u_prev=u_p,
+        u_cur=u_c,
+        abs_errors=np.asarray(abs_all, dtype=np.float64),
+        rel_errors=np.asarray(rel_all, dtype=np.float64),
+        init_seconds=init_s,
+        solve_seconds=solve_s,
+        steps_computed=nsteps - start_step,
+        final_step=nsteps,
+    )
