@@ -1,0 +1,202 @@
+"""Native checkpoint IO: CRC32, async writer, WTS1 container, fallback.
+
+The C++ library (wavetpu/io/native/ckptio.cc) compiles on first use; these
+tests exercise BOTH the native path and the pure-Python fallback and pin
+that the two produce byte-identical files - the format is the contract,
+the implementation is an accelerator.
+"""
+
+import os
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from wavetpu.io import nativeio
+
+
+@pytest.fixture
+def fallback(monkeypatch):
+    """Force the pure-Python IO path."""
+    monkeypatch.setattr(nativeio, "_lib", None)
+    monkeypatch.setattr(nativeio, "_lib_tried", True)
+
+
+def test_native_builds():
+    """The toolchain in this image must produce the library (the fallback
+    exists for exotic deployments, not for CI)."""
+    assert nativeio.native_available()
+
+
+@pytest.mark.parametrize("n", [0, 1, 7, 8, 64, 100_003])
+def test_crc32_matches_zlib(n):
+    rng = np.random.default_rng(n)
+    data = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+    assert nativeio.crc32(data) == zlib.crc32(data) & 0xFFFFFFFF
+    # seeded / incremental
+    assert (
+        nativeio.crc32(data[n // 2:], nativeio.crc32(data[: n // 2]))
+        == zlib.crc32(data) & 0xFFFFFFFF
+    )
+
+
+def _roundtrip(tmp_path, name):
+    path = str(tmp_path / name)
+    chunks = [b"hello ", b"", b"checkpoint " * 1000, os.urandom(12345)]
+    w = nativeio.AsyncFileWriter(path)
+    for c in chunks:
+        w.write(c)
+    crc = w.finish()
+    blob = open(path, "rb").read()
+    assert blob == b"".join(chunks)
+    assert crc == zlib.crc32(blob) & 0xFFFFFFFF
+    assert not os.path.exists(w.tmp_path)
+    return blob
+
+
+def test_async_writer_roundtrip(tmp_path):
+    _roundtrip(tmp_path, "native.bin")
+
+
+def test_async_writer_roundtrip_fallback(tmp_path, fallback):
+    _roundtrip(tmp_path, "fallback.bin")
+
+
+def test_async_writer_abort(tmp_path):
+    path = str(tmp_path / "aborted.bin")
+    w = nativeio.AsyncFileWriter(path)
+    w.write(b"partial data")
+    w.abort()
+    assert not os.path.exists(path)
+    assert not os.path.exists(w.tmp_path)
+
+
+def _sample_arrays():
+    rng = np.random.default_rng(0)
+    f32 = rng.standard_normal((4, 6, 8)).astype(np.float32)
+    bf16_bits = rng.integers(0, 2**16, (3, 5), dtype=np.uint16)
+    return {
+        "u_cur": (f32, "float32"),
+        "u_prev": (f32 * 2, "float32"),
+        "packed": (bf16_bits, "bfloat16"),
+    }
+
+
+def test_container_roundtrip(tmp_path):
+    path = str(tmp_path / "shard.wts")
+    arrays = _sample_arrays()
+    nativeio.write_container_sync(path, arrays, meta={"step": 7})
+    out, meta = nativeio.read_container(path)
+    assert meta == {"step": 7}
+    for name, (arr, tag) in arrays.items():
+        got, got_tag = out[name]
+        assert got_tag == tag
+        np.testing.assert_array_equal(got, arr)
+
+
+def test_container_native_and_fallback_bytes_identical(
+    tmp_path, monkeypatch
+):
+    arrays = _sample_arrays()
+    p_native = str(tmp_path / "n.wts")
+    nativeio.write_container_sync(p_native, arrays, meta={"step": 3})
+    monkeypatch.setattr(nativeio, "_lib", None)
+    monkeypatch.setattr(nativeio, "_lib_tried", True)
+    p_py = str(tmp_path / "p.wts")
+    nativeio.write_container_sync(p_py, arrays, meta={"step": 3})
+    assert open(p_native, "rb").read() == open(p_py, "rb").read()
+
+
+def test_container_detects_corruption(tmp_path):
+    path = str(tmp_path / "shard.wts")
+    nativeio.write_container_sync(path, _sample_arrays(), meta={"step": 1})
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0x40  # flip one payload bit
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(ValueError, match="CRC mismatch"):
+        nativeio.read_container(path)
+    # verify=False skips the check (for forensic inspection)
+    nativeio.read_container(path, verify=False)
+
+
+def test_container_detects_truncation(tmp_path):
+    path = str(tmp_path / "shard.wts")
+    nativeio.write_container_sync(path, _sample_arrays(), meta={"step": 1})
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[: len(blob) - 20])
+    with pytest.raises(ValueError, match="truncated"):
+        nativeio.read_container(path)
+
+
+def test_container_rejects_foreign_file(tmp_path):
+    path = str(tmp_path / "not_a_ckpt")
+    open(path, "wb").write(b"something else entirely" * 10)
+    with pytest.raises(ValueError, match="not a WTS1"):
+        nativeio.read_container(path)
+
+
+def test_sharded_checkpoint_legacy_npz_still_loads(tmp_path):
+    """A pre-WTS1 per-shard checkpoint (.npz shards) still resumes."""
+    import jax
+
+    from wavetpu.core.problem import Problem
+    from wavetpu.io import checkpoint as ckpt
+    from wavetpu.solver import sharded
+
+    p = Problem(N=16, timesteps=8)
+    part = sharded.solve_sharded(p, mesh_shape=(2, 1, 1), stop_step=4)
+    path = str(tmp_path / "ck")
+    ckpt.save_sharded_checkpoint(path, part)
+    # Rewrite every WTS1 shard in the legacy .npz layout and delete it.
+    for fn in sorted(os.listdir(path)):
+        if not fn.endswith(".wts"):
+            continue
+        fields, meta = nativeio.read_container(os.path.join(path, fn))
+        legacy = {"step": meta["step"]}
+        for name, (arr, tag) in fields.items():
+            legacy[name] = arr
+            legacy[f"{name}_dtype"] = tag
+        np.savez(os.path.join(path, fn[:-4] + ".npz"), **legacy)
+        os.remove(os.path.join(path, fn))
+    res = ckpt.resume_sharded_solve(path)
+    full = sharded.solve_sharded(p, mesh_shape=(2, 1, 1))
+    np.testing.assert_array_equal(
+        np.asarray(res.u_cur), np.asarray(full.u_cur)
+    )
+
+
+def test_sharded_checkpoint_corrupt_shard_rejected(tmp_path):
+    from wavetpu.core.problem import Problem
+    from wavetpu.io import checkpoint as ckpt
+    from wavetpu.solver import sharded
+
+    p = Problem(N=16, timesteps=8)
+    part = sharded.solve_sharded(p, mesh_shape=(2, 1, 1), stop_step=4)
+    path = str(tmp_path / "ck")
+    ckpt.save_sharded_checkpoint(path, part)
+    shard = next(
+        os.path.join(path, f) for f in sorted(os.listdir(path))
+        if f.endswith(".wts")
+    )
+    blob = bytearray(open(shard, "rb").read())
+    blob[len(blob) // 2] ^= 0x01
+    open(shard, "wb").write(bytes(blob))
+    with pytest.raises(ValueError, match="CRC mismatch"):
+        ckpt.resume_sharded_solve(path)
+
+
+def test_missing_wts_shard_reported_by_current_name(tmp_path):
+    """A lost .wts shard is reported as the missing .wts file, not as a
+    legacy .npz the user never had."""
+    from wavetpu.core.problem import Problem
+    from wavetpu.io import checkpoint as ckpt
+    from wavetpu.solver import sharded
+
+    p = Problem(N=16, timesteps=8)
+    part = sharded.solve_sharded(p, mesh_shape=(2, 1, 1), stop_step=4)
+    path = str(tmp_path / "ck")
+    ckpt.save_sharded_checkpoint(path, part)
+    os.remove(os.path.join(path, "shard_0_0_0.wts"))
+    with pytest.raises(FileNotFoundError, match=r"shard_0_0_0\.wts"):
+        ckpt.load_sharded_checkpoint(path)

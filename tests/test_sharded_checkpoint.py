@@ -160,11 +160,11 @@ def test_mixed_step_checkpoint_rejected(small_problem, tmp_path):
     )
     checkpoint.save_sharded_checkpoint(ck, half)
     # Simulate: one shard got overwritten by a newer (step-7) save.
-    shard = os.path.join(ck, "shard_0_0_0.npz")
-    with np.load(shard) as z:
-        data = {k: z[k] for k in z.files}
-    data["step"] = np.asarray(7)
-    np.savez(shard, **data)
+    from wavetpu.io import nativeio
+
+    shard = os.path.join(ck, "shard_0_0_0.wts")
+    fields, _meta = nativeio.read_container(shard)
+    nativeio.write_container_sync(shard, fields, meta={"step": 7})
     with pytest.raises(ValueError, match="interrupted mid-save"):
         checkpoint.load_sharded_checkpoint(ck)
 

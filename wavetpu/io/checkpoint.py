@@ -136,6 +136,10 @@ def load_checkpoint(path: str) -> Tuple[Problem, np.ndarray, np.ndarray, int]:
 
 
 def _shard_filename(starts) -> str:
+    return f"shard_{starts[0]}_{starts[1]}_{starts[2]}.wts"
+
+
+def _legacy_shard_filename(starts) -> str:
     return f"shard_{starts[0]}_{starts[1]}_{starts[2]}.npz"
 
 
@@ -148,20 +152,30 @@ def save_sharded_checkpoint(path_dir: str, result: SolveResult) -> str:
     host-memory and file-size cost per process is O(state / n_processes)
     instead of one dense ~68 GB .npz at the N=2048 stretch config.
     Layout: `meta.npz` (problem, step, mesh shape, state dtype; process 0
-    only) + `shard_{x0}_{y0}_{z0}.npz` keyed by global start offsets.
+    only) + `shard_{x0}_{y0}_{z0}.wts` (WTS1 containers, io/nativeio.py)
+    keyed by global start offsets.
 
     Crash consistency: every file is written to a temp name and renamed
-    (atomic per file), each shard carries the step it belongs to, and the
-    loader rejects any shard whose step disagrees with meta - so a
-    preemption mid-way through OVERWRITING an older checkpoint cannot be
-    silently resumed as mixed-step state.  (On multi-host, rank 0's meta
-    write is not ordered after other hosts' shard writes; a deployment
-    wanting cross-host atomicity should save each checkpoint to a fresh
-    directory and rename at the orchestration layer.)
+    (atomic per file), each shard carries a CRC32 footer and the step it
+    belongs to, and the loader rejects any shard whose CRC fails or whose
+    step disagrees with meta - so a preemption mid-way through OVERWRITING
+    an older checkpoint cannot be silently resumed as mixed-step or torn
+    state.  (On multi-host, rank 0's meta write is not ordered after other
+    hosts' shard writes; a deployment wanting cross-host atomicity should
+    save each checkpoint to a fresh directory and rename at the
+    orchestration layer.)
+
+    IO path: shards are WTS1 containers streamed by the native async
+    writer (io/nativeio.py: C++ background thread, CRC32, atomic rename) -
+    the disk write of shard i overlaps assembling shard i+1, and a pure-
+    Python fallback produces byte-identical files where no compiler
+    exists.  Legacy .npz shard checkpoints remain loadable.
     """
     import os
 
     import jax
+
+    from wavetpu.io import nativeio
 
     p = result.problem
     step = (
@@ -200,27 +214,28 @@ def save_sharded_checkpoint(path_dir: str, result: SolveResult) -> str:
         if compensated
         else None
     )
-    for sc in u_cur.addressable_shards:
-        starts = starts_of(sc.index)
-        prev_block, prev_tag = _encode_field(prev_by_start[starts])
-        cur_block, cur_tag = _encode_field(sc.data)
-        extra = {}
-        if compensated:
-            v_block, v_tag = _encode_field(aux_by_start[0][starts])
-            c_block, c_tag = _encode_field(aux_by_start[1][starts])
-            extra = dict(
-                comp_v=v_block, comp_carry=c_block,
-                comp_v_dtype=v_tag, comp_carry_dtype=c_tag,
+    in_flight = []
+    try:
+        for sc in u_cur.addressable_shards:
+            starts = starts_of(sc.index)
+            fields = dict(
+                u_prev=_encode_field(prev_by_start[starts]),
+                u_cur=_encode_field(sc.data),
             )
-        atomic_savez(
-            _shard_filename(starts),
-            step=step,
-            u_prev=prev_block,
-            u_cur=cur_block,
-            u_prev_dtype=prev_tag,
-            u_cur_dtype=cur_tag,
-            **extra,
-        )
+            if compensated:
+                fields["comp_v"] = _encode_field(aux_by_start[0][starts])
+                fields["comp_carry"] = _encode_field(aux_by_start[1][starts])
+            in_flight.append(nativeio.write_container(
+                os.path.join(path_dir, _shard_filename(starts)),
+                fields,
+                meta={"step": step},
+            ))
+        for w in in_flight:
+            nativeio.finish_container(w)
+    except Exception:
+        for w in in_flight:
+            w.abort()
+        raise
     if jax.process_index() == 0:
         atomic_savez(
             "meta.npz",
@@ -291,14 +306,37 @@ def load_sharded_checkpoint(path_dir: str, devices=None):
     buffers = {"u_prev": [], "u_cur": []}
     if compensated:
         buffers.update(comp_v=[], comp_carry=[])
+    from wavetpu.io import nativeio
+
     for dev, idx in imap.items():
         starts = tuple(int(sl.start or 0) for sl in idx)
-        with np.load(
-            os.path.join(path_dir, _shard_filename(starts))
-        ) as z:
-            if "step" in z.files and int(z["step"]) != step:
+        wts_path = os.path.join(path_dir, _shard_filename(starts))
+        if os.path.exists(wts_path):
+            fields, shard_meta = nativeio.read_container(wts_path)
+            if shard_meta.get("step") != step:
                 raise ValueError(
                     f"shard {_shard_filename(starts)} holds step "
+                    f"{shard_meta.get('step')} but meta says {step}: "
+                    f"checkpoint was interrupted mid-save; discard it"
+                )
+            for key, bufs in buffers.items():
+                arr, dt = fields[key]
+                bufs.append(jax.device_put(_decode_field(arr, dt), dev))
+            continue
+        # Legacy .npz shard layout (pre-WTS1 checkpoints).  A checkpoint
+        # with NEITHER file is reported against the current format's name,
+        # not the legacy one.
+        legacy_path = os.path.join(
+            path_dir, _legacy_shard_filename(starts)
+        )
+        if not os.path.exists(legacy_path):
+            raise FileNotFoundError(
+                f"checkpoint shard missing: {wts_path}"
+            )
+        with np.load(legacy_path) as z:
+            if "step" in z.files and int(z["step"]) != step:
+                raise ValueError(
+                    f"shard {_legacy_shard_filename(starts)} holds step "
                     f"{int(z['step'])} but meta says {step}: checkpoint "
                     f"was interrupted mid-save; discard it"
                 )
