@@ -49,7 +49,7 @@ def main() -> int:
 
     from wavetpu.core.problem import Problem
     from wavetpu.kernels import stencil_pallas
-    from wavetpu.solver import kfused, leapfrog, sharded
+    from wavetpu.solver import kfused, leapfrog, sharded, sharded_kfused
 
     dev = jax.devices()[0]
     n = 512
@@ -106,6 +106,12 @@ def main() -> int:
             "sharded_pallas_mesh111",
             lambda: sharded.solve_sharded(
                 problem, mesh_shape=(1, 1, 1), kernel="pallas"
+            ),
+        ),
+        "sharded_kfused_k4_1shard": _run(
+            "sharded_kfused_k4_1shard",
+            lambda: sharded_kfused.solve_sharded_kfused(
+                problem, n_shards=1, k=4, interpret=not on_tpu
             ),
         ),
         "compensated_pallas_f32": _run(

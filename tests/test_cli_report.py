@@ -154,7 +154,10 @@ def test_cli_fuse_steps(tmp_path, capsys):
         base + ["--backend", "single", "--kernel", "pallas",
                 "--out-dir", one_dir]
     ) == 0
-    assert cli.main(base + ["--fuse-steps", "4", "--out-dir", k_dir]) == 0
+    assert cli.main(
+        base + ["--backend", "single", "--fuse-steps", "4",
+                "--out-dir", k_dir]
+    ) == 0
     out = capsys.readouterr().out
     assert "fuse-steps: 4" in out
     one = json.load(open(os.path.join(one_dir, "output_N16_Np1_TPU.json")))
@@ -179,12 +182,13 @@ def test_cli_fuse_steps_validation(capsys):
 
 def test_cli_fuse_steps_resume_guards(tmp_path, capsys):
     """--fuse-steps must not silently bypass resume semantics: a sharded
-    checkpoint directory is rejected, and a compensated checkpoint (whose
-    scheme is inherited AFTER flag validation) is rejected too."""
+    checkpoint on a non-x-only mesh is rejected, and a compensated
+    checkpoint (whose scheme is inherited AFTER flag validation) is
+    rejected too."""
     base = ["16", "1", "1", "1", "1", "1", "8"]
     shard_ck = str(tmp_path / "shard_ck")
     assert cli.main(
-        base + ["--mesh", "1,1,1", "--stop-step", "3",
+        base + ["--mesh", "1,2,1", "--stop-step", "3",
                 "--save-state", shard_ck, "--out-dir", str(tmp_path)]
     ) == 0
     assert cli.main(["--resume", shard_ck, "--fuse-steps", "4"]) == 2
@@ -196,7 +200,51 @@ def test_cli_fuse_steps_resume_guards(tmp_path, capsys):
     ) == 0
     assert cli.main(["--resume", comp_ck, "--fuse-steps", "4"]) == 2
     err = capsys.readouterr().err
-    assert "per-shard" in err and "compensated" in err
+    assert "x-only" in err and "compensated" in err
+
+
+def test_cli_fuse_steps_sharded(tmp_path, capsys):
+    """--fuse-steps + --mesh MX,1,1 runs the x-sharded k-fused solver and
+    matches the single-device k-fused report; y/z meshes are rejected."""
+    base = ["16", "1", "1", "1", "1", "1", "9"]
+    one_dir, sh_dir = str(tmp_path / "one"), str(tmp_path / "sh")
+    assert cli.main(
+        base + ["--fuse-steps", "4", "--out-dir", one_dir,
+                "--backend", "single"]
+    ) == 0
+    assert cli.main(
+        base + ["--fuse-steps", "4", "--mesh", "2,1,1",
+                "--out-dir", sh_dir]
+    ) == 0
+    assert cli.main(base + ["--fuse-steps", "4", "--mesh", "2,2,1"]) == 2
+    capsys.readouterr()
+    one = json.load(open(os.path.join(one_dir, "output_N16_Np1_TPU.json")))
+    sh = json.load(open(os.path.join(sh_dir, "output_N16_Np2_TPU.json")))
+    assert sh["abs_errors"] == pytest.approx(one["abs_errors"], rel=1e-5)
+
+
+def test_cli_fuse_steps_sharded_resume(tmp_path, capsys):
+    """An x-only sharded checkpoint resumes under --fuse-steps with the
+    same error tail as the uninterrupted sharded k-fused run."""
+    base = ["16", "1", "1", "1", "1", "1", "10", "--mesh", "2,1,1",
+            "--fuse-steps", "4"]
+    full_dir, res_dir = str(tmp_path / "full"), str(tmp_path / "res")
+    ck = str(tmp_path / "ck")
+    assert cli.main(base + ["--out-dir", full_dir]) == 0
+    assert cli.main(
+        base + ["--out-dir", str(tmp_path), "--stop-step", "6",
+                "--save-state", ck]
+    ) == 0
+    assert cli.main(
+        ["--resume", ck, "--fuse-steps", "4", "--out-dir", res_dir]
+    ) == 0
+    capsys.readouterr()
+    full = json.load(open(os.path.join(full_dir, "output_N16_Np2_TPU.json")))
+    res = json.load(open(os.path.join(res_dir, "output_N16_Np2_TPU.json")))
+    assert res["abs_errors"][7:] == pytest.approx(
+        full["abs_errors"][7:], rel=1e-6
+    )
+    assert all(e == 0 for e in res["abs_errors"][:7])
 
 
 def test_cli_fuse_steps_resume_continues(tmp_path, capsys):
@@ -219,3 +267,21 @@ def test_cli_fuse_steps_resume_continues(tmp_path, capsys):
     res = json.load(open(os.path.join(res_dir, "output_N16_Np1_TPU.json")))
     assert res["abs_errors"][7:] == full["abs_errors"][7:]
     assert all(e == 0 for e in res["abs_errors"][:7])
+
+
+def test_cli_fuse_steps_bad_mesh_values(capsys):
+    base = ["16", "1", "1", "1", "1", "1", "5", "--fuse-steps", "4"]
+    assert cli.main(base + ["--mesh", "0,1,1"]) == 2
+    assert cli.main(base + ["--mesh", "-2,1,1"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_fuse_steps_auto_stays_single(tmp_path, capsys):
+    """Bare --fuse-steps (no --mesh/--backend) runs single-device even on a
+    multi-device host: sharding is explicit opt-in (N=20 would not divide
+    the 8-device test mesh, which is exactly the point)."""
+    rc = cli.main(["20", "1", "1", "1", "1", "1", "5", "--fuse-steps", "4",
+                   "--out-dir", str(tmp_path)])
+    assert rc == 0
+    assert os.path.exists(tmp_path / "output_N20_Np1_TPU.txt")
+    capsys.readouterr()

@@ -200,3 +200,12 @@ def test_missing_wts_shard_reported_by_current_name(tmp_path):
     os.remove(os.path.join(path, "shard_0_0_0.wts"))
     with pytest.raises(FileNotFoundError, match=r"shard_0_0_0\.wts"):
         ckpt.load_sharded_checkpoint(path)
+
+
+def test_write_after_finish_raises(tmp_path):
+    path = str(tmp_path / "done.bin")
+    w = nativeio.AsyncFileWriter(path)
+    w.write(b"data")
+    w.finish()
+    with pytest.raises((IOError, ValueError)):
+        w.write(b"more")

@@ -1,0 +1,135 @@
+"""x-sharded k-fused solver: parity with the single-device k-fused path.
+
+The sharded k-step kernel consumes ppermute'd ghost planes where the
+single-device kernel wraps around - identical values through identical op
+order - so the final state must match BITWISE across mesh sizes, and the
+per-layer error rows must assemble to the same global errors.  Runs on
+the 8-virtual-CPU mesh in interpret mode (tests/conftest.py).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wavetpu.core.problem import Problem
+from wavetpu.solver import kfused, sharded_kfused
+
+
+@pytest.mark.parametrize("n_shards,k,timesteps", [
+    (2, 2, 11),
+    (2, 4, 9),
+    (4, 4, 13),   # nl = 4 = k: every program is both edges
+    (8, 2, 9),    # nl = 2: minimal shard depth
+    (1, 4, 9),    # single-shard mesh == single-device data path
+])
+def test_state_matches_single_device_kfused(n_shards, k, timesteps):
+    p = Problem(N=16, timesteps=timesteps)
+    want = kfused.solve_kfused(p, k=k, interpret=True)
+    got = sharded_kfused.solve_sharded_kfused(
+        p, n_shards=n_shards, k=k, interpret=True
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.u_cur), np.asarray(want.u_cur)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.u_prev), np.asarray(want.u_prev)
+    )
+
+
+@pytest.mark.parametrize("n_shards,k", [(2, 2), (4, 4)])
+def test_errors_match_single_device_kfused(n_shards, k):
+    p = Problem(N=16, timesteps=11)
+    want = kfused.solve_kfused(p, k=k, interpret=True)
+    got = sharded_kfused.solve_sharded_kfused(
+        p, n_shards=n_shards, k=k, interpret=True
+    )
+    np.testing.assert_allclose(
+        got.abs_errors, want.abs_errors, rtol=1e-5, atol=1e-7
+    )
+    np.testing.assert_allclose(got.rel_errors, want.rel_errors, rtol=1e-5)
+
+
+def test_stop_resume_bitwise():
+    p = Problem(N=16, timesteps=13)
+    full = sharded_kfused.solve_sharded_kfused(
+        p, n_shards=2, k=4, interpret=True
+    )
+    part = sharded_kfused.solve_sharded_kfused(
+        p, n_shards=2, k=4, stop_step=6, interpret=True
+    )
+    res = sharded_kfused.resume_sharded_kfused(
+        p, part.u_prev, part.u_cur, start_step=6, n_shards=2, k=4,
+        interpret=True,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res.u_cur), np.asarray(full.u_cur)
+    )
+    np.testing.assert_allclose(
+        res.abs_errors[7:], full.abs_errors[7:], rtol=1e-6
+    )
+    assert (res.abs_errors[:7] == 0).all()
+
+
+def test_resume_from_host_checkpoint_roundtrip(tmp_path):
+    """Save via the per-shard checkpoint writer, resume k-fused: bitwise."""
+    from wavetpu.io import checkpoint as ckpt
+
+    p = Problem(N=16, timesteps=12)
+    full = sharded_kfused.solve_sharded_kfused(
+        p, n_shards=2, k=4, interpret=True
+    )
+    part = sharded_kfused.solve_sharded_kfused(
+        p, n_shards=2, k=4, stop_step=5, interpret=True
+    )
+    path = str(tmp_path / "ck")
+    ckpt.save_sharded_checkpoint(path, part)
+    problem2, u_prev, u_cur, step, mesh_shape, scheme, aux = (
+        ckpt.load_sharded_checkpoint(path)
+    )
+    assert mesh_shape == (2, 1, 1) and step == 5 and scheme == "standard"
+    res = sharded_kfused.resume_sharded_kfused(
+        problem2, np.asarray(u_prev), np.asarray(u_cur), start_step=step,
+        n_shards=2, k=4, interpret=True,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res.u_cur), np.asarray(full.u_cur)
+    )
+
+
+def test_no_errors_mode():
+    p = Problem(N=16, timesteps=9)
+    got = sharded_kfused.solve_sharded_kfused(
+        p, n_shards=2, k=4, compute_errors=False, interpret=True
+    )
+    assert (got.abs_errors == 0).all()
+    want = kfused.solve_kfused(p, k=4, compute_errors=False, interpret=True)
+    np.testing.assert_array_equal(
+        np.asarray(got.u_cur), np.asarray(want.u_cur)
+    )
+
+
+def test_bf16_state():
+    p = Problem(N=16, timesteps=9)
+    want = kfused.solve_kfused(p, dtype=jnp.bfloat16, k=4, interpret=True)
+    got = sharded_kfused.solve_sharded_kfused(
+        p, n_shards=2, dtype=jnp.bfloat16, k=4, interpret=True
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.u_cur.astype(jnp.float32)),
+        np.asarray(want.u_cur.astype(jnp.float32)),
+    )
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="N % shards"):
+        sharded_kfused.solve_sharded_kfused(
+            Problem(N=18, timesteps=8), n_shards=4, k=2, interpret=True
+        )
+    with pytest.raises(ValueError, match="shard depth"):
+        sharded_kfused.solve_sharded_kfused(
+            Problem(N=16, timesteps=8), n_shards=8, k=4, interpret=True
+        )
+    with pytest.raises(ValueError, match="k must be >= 2"):
+        sharded_kfused.solve_sharded_kfused(
+            Problem(N=16, timesteps=8), n_shards=2, k=1, interpret=True
+        )

@@ -46,8 +46,11 @@ pick at runtime):
                                     Gcell/s at K=4, N=512/1000 on v5e, with
                                     per-layer errors still reported).
                                     Requires the pallas kernel, the standard
-                                    scheme, the single backend, and K | N;
-                                    layers are bitwise identical to K=1
+                                    scheme, and K | N/MX; single device or an
+                                    x-only mesh (--mesh MX,1,1 ->
+                                    solver/sharded_kfused.py, K-plane ghost
+                                    exchange per K layers); layers are
+                                    bitwise identical to K=1
   --overlap                         overlap halo exchange with the bulk
                                     stencil update (sharded backend, even
                                     shard splits only)
@@ -156,9 +159,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     "--fuse-steps is not available for the compensated "
                     "scheme"
                 )
-            if flags.get("backend") == "sharded" or "mesh" in flags:
+            if "mesh" in flags:
+                # k-fusion composes with x-only decomposition (the y/z
+                # rolls must stay full-domain, solver/sharded_kfused.py).
+                try:
+                    _m = tuple(int(x) for x in flags["mesh"].split(","))
+                except ValueError:
+                    _m = ()
+                if len(_m) == 3 and (_m[1:] != (1, 1) or _m[0] < 1):
+                    raise ValueError(
+                        "--fuse-steps supports x-only meshes (MX,1,1, "
+                        f"MX >= 1); got {flags['mesh']}"
+                    )
+            if "overlap" in flags:
                 raise ValueError(
-                    "--fuse-steps runs on the single-device backend"
+                    "--overlap applies to the 1-step sharded backend, not "
+                    "--fuse-steps (whose exchange is amortized over k "
+                    "layers)"
                 )
             if "phase-timing" in flags:
                 raise ValueError(
@@ -202,13 +219,6 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from wavetpu.io import checkpoint as _ckpt
 
         resume_is_sharded = _os.path.isdir(flags["resume"])
-        if resume_is_sharded and fuse_steps > 1:
-            print(
-                "error: --fuse-steps runs on the single-device backend; "
-                "it cannot resume a per-shard checkpoint directory",
-                file=sys.stderr,
-            )
-            return 2
         try:
             if resume_is_sharded:
                 if flags.get("backend") == "single":
@@ -229,6 +239,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     print(
                         f"error: --mesh contradicts the checkpoint's mesh "
                         f"{_ck_mesh}",
+                        file=sys.stderr,
+                    )
+                    return 2
+                if fuse_steps > 1 and _ck_mesh[1:] != (1, 1):
+                    print(
+                        f"error: --fuse-steps supports x-only meshes; the "
+                        f"checkpoint was saved on {_ck_mesh}",
                         file=sys.stderr,
                     )
                     return 2
@@ -331,11 +348,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     elif backend == "auto":
         backend = "sharded" if n_devices > 1 else "single"
     if fuse_steps > 1:
-        backend = "single"  # validated above: sharded was rejected
-        if problem.N % fuse_steps:
+        # k-fusion goes sharded only on EXPLICIT request (--mesh MX,1,1,
+        # --backend sharded, or a sharded checkpoint); plain auto stays
+        # single-device, preserving the K=1 CLI's behavior.
+        explicit_sharded = (
+            "mesh" in flags or resume_is_sharded
+            or flags.get("backend") == "sharded"
+        )
+        backend = "sharded" if explicit_sharded else "single"
+        n_x_shards = (mesh_shape or (_ck_mesh if resume_is_sharded else None)
+                      or (n_devices, 1, 1))[0] if backend == "sharded" else 1
+        if problem.N % n_x_shards or (
+            problem.N // n_x_shards
+        ) % fuse_steps:
             print(
-                f"error: --fuse-steps {fuse_steps} must divide N="
-                f"{problem.N}",
+                f"error: --fuse-steps {fuse_steps} must divide the "
+                f"per-shard depth N/MX = {problem.N}/{n_x_shards}",
                 file=sys.stderr,
             )
             return 2
@@ -394,28 +422,56 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # op-level picture.
         jax.profiler.start_trace(profile_dir)
 
-    if backend == "sharded":
+    if backend == "sharded" and resume_is_sharded:
+        # Shared load for both sharded resume paths (1-step and k-fused).
+        from wavetpu.io import checkpoint as _ckpt
+
+        try:
+            (problem, _u_prev0, _u_cur0, _start, _ck_mesh,
+             _ck_scheme, _ck_aux) = (
+                _ckpt.load_sharded_checkpoint(flags["resume"])
+            )
+        except Exception as e:
+            # Missing/truncated shard files, step/meta mismatch from a
+            # mid-save preemption, or too few devices for the stored
+            # mesh - same clean exit as a corrupt .npz.
+            print(f"error: cannot load checkpoint: {e}", file=sys.stderr)
+            return 2
+        resume_dtype = (
+            dtype if "dtype" in flags else jnp.dtype(_u_cur0.dtype)
+        )
+
+    if backend == "sharded" and fuse_steps > 1:
+        from wavetpu.solver import sharded_kfused
+
+        if resume_is_sharded:
+            result = sharded_kfused.resume_sharded_kfused(
+                problem,
+                _u_prev0,
+                _u_cur0,
+                start_step=_start,
+                n_shards=_ck_mesh[0],
+                dtype=resume_dtype,
+                k=fuse_steps,
+                compute_errors=compute_errors,
+            )
+            shape = _ck_mesh
+        else:
+            shape = mesh_shape or (n_devices, 1, 1)
+            result = sharded_kfused.solve_sharded_kfused(
+                problem,
+                n_shards=shape[0],
+                dtype=dtype,
+                k=fuse_steps,
+                compute_errors=compute_errors,
+                stop_step=stop_step,
+            )
+        n_procs = shape[0] * shape[1] * shape[2]
+        variant = "TPU"
+    elif backend == "sharded":
         from wavetpu.solver import sharded
 
         if resume_is_sharded:
-            from wavetpu.io import checkpoint as _ckpt
-
-            try:
-                (problem, _u_prev0, _u_cur0, _start, _ck_mesh,
-                 _ck_scheme, _ck_aux) = (
-                    _ckpt.load_sharded_checkpoint(flags["resume"])
-                )
-            except Exception as e:
-                # Missing/truncated shard files, step/meta mismatch from a
-                # mid-save preemption, or too few devices for the stored
-                # mesh - same clean exit as a corrupt .npz.
-                print(
-                    f"error: cannot load checkpoint: {e}", file=sys.stderr
-                )
-                return 2
-            resume_dtype = (
-                dtype if "dtype" in flags else jnp.dtype(_u_cur0.dtype)
-            )
             _v, _c = _ck_aux if _ck_aux is not None else (None, None)
             result = sharded.resume_sharded(
                 problem,

@@ -138,6 +138,10 @@ class AsyncFileWriter:
             self._file = open(self.tmp_path, "wb")
 
     def write(self, data) -> None:
+        if self._lib is not None and self._handle is None:
+            # finish()/abort() already ran; the native call would
+            # dereference a NULL handle (SIGSEGV, not an exception).
+            raise IOError("write after finish/abort")
         mv = memoryview(data).cast("B")
         if not mv.nbytes:
             return

@@ -643,9 +643,15 @@ _KSTEP_VMEM_LIMIT = 127 * 1024 * 1024
 _KSTEP_VMEM_BUDGET = 122 * 1024 * 1024
 
 
-def choose_kstep_block(n: int, k: int, itemsize: int = 4) -> Optional[int]:
+def choose_kstep_block(
+    n: int, k: int, itemsize: int = 4, depth: Optional[int] = None,
+    ghosts: bool = False,
+) -> Optional[int]:
     """Largest slab depth bx (multiple of k, power-of-two steps, <= 8,
-    dividing n) whose k-step pipeline fits VMEM; None if even bx=k does not.
+    dividing `depth`) whose k-step pipeline fits VMEM; None if even bx=k
+    does not.  `n` sets the (y, z) plane size; `depth` the x extent being
+    blocked (= n single-device, the shard depth N/P sharded); `ghosts`
+    adds the sharded variant's 4 single-fetched k-plane ghost buffers.
 
     Working-set model (validated against Mosaic's scoped-vmem accounting at
     N=512: est 120 MB vs actual 114 MB for k=2/bx=8): the double-buffered
@@ -653,13 +659,17 @@ def choose_kstep_block(n: int, k: int, itemsize: int = 4) -> Optional[int]:
     kernel body another ~3 onion-sized f32 temporaries, plus the two
     (N,N) oracle planes.
     """
+    if depth is None:
+        depth = n
     pb_state = n * n * itemsize
     pb_f32 = n * n * 4
     best = None
     bx = k
-    while bx <= 8:
-        if n % bx == 0:
+    while bx <= 8 and bx <= depth:
+        if depth % bx == 0:
             pipeline = 2 * (4 * bx + 4 * k) * pb_state
+            if ghosts:
+                pipeline += 4 * k * pb_state
             planes = 4 * pb_f32
             temps = 3 * (bx + 2 * k) * pb_f32
             if pipeline + planes + temps <= _KSTEP_VMEM_BUDGET:
@@ -794,6 +804,153 @@ def fused_kstep(u_prev, u, syz, rsyz, sxct, *, k, coeff, inv_h2,
         ),
         interpret=interpret,
     )(sxct, u_prev, u, u_prev, u_prev, u, u, syz, rsyz)
+    if with_errors:
+        return out
+    return out[0], out[1], None, None
+
+
+def _kstep_sharded_kernel(sxct_ref, uprev_ref, uc_ref, plo_ref, phi_ref,
+                          lo_ref, hi_ref, pglo_ref, pghi_ref, glo_ref,
+                          ghi_ref, syz_ref, rsyz_ref, *out_refs,
+                          k, bx, coeff, inv_h2, compute_dtype, with_errors):
+    """`_kstep_kernel` for an x-sharded block: the k-plane halos of the
+    block's EDGE programs come from the ppermute'd ghost operands (the
+    neighbouring shard's boundary planes) instead of the in-block
+    wraparound - interior programs are untouched, so a 1-shard mesh
+    compiles to the single-device onion's data path.  y/z stay full-domain
+    per shard (x-only decomposition), so the in-VMEM rolls and the fused
+    Dirichlet mask are exactly the single-device kernel's."""
+    if with_errors:
+        out_prev_ref, out_ref, dmax_ref, rmax_ref = out_refs
+    else:
+        out_prev_ref, out_ref = out_refs
+    i = pl.program_id(0)
+    last = pl.num_programs(0) - 1
+    f = compute_dtype
+    ix, iy, iz = (jnp.asarray(v, f) for v in inv_h2)
+
+    def pick(edge_is_lo, ghost_ref, wrap_ref):
+        at_edge = (i == 0) if edge_is_lo else (i == last)
+        return jnp.where(
+            at_edge, ghost_ref[:].astype(f), wrap_ref[:].astype(f)
+        )
+
+    prev = jnp.concatenate([
+        pick(True, pglo_ref, plo_ref),
+        uprev_ref[:].astype(f),
+        pick(False, pghi_ref, phi_ref),
+    ], 0)
+    cur = jnp.concatenate([
+        pick(True, glo_ref, lo_ref),
+        uc_ref[:].astype(f),
+        pick(False, ghi_ref, hi_ref),
+    ], 0)
+    syz = syz_ref[:]
+    rsyz = rsyz_ref[:]
+    ny, nz = syz.shape
+
+    ym = lax.broadcasted_iota(jnp.int32, (1, ny, nz), 1) != 0
+    zm = lax.broadcasted_iota(jnp.int32, (1, ny, nz), 2) != 0
+    mask = ym & zm
+
+    for s in range(1, k + 1):
+        c = cur[1:-1]
+        lap = (cur[:-2] + cur[2:] - 2.0 * c) * ix
+        lap = lap + (
+            pltpu.roll(c, 1, 1) + pltpu.roll(c, ny - 1, 1) - 2.0 * c
+        ) * iy
+        lap = lap + (
+            pltpu.roll(c, 1, 2) + pltpu.roll(c, nz - 1, 2) - 2.0 * c
+        ) * iz
+        new = 2.0 * c + jnp.asarray(coeff, f) * lap - prev[1:-1]
+        new = jnp.where(mask, new, jnp.asarray(0.0, f))
+        if out_ref.dtype != f:
+            new = new.astype(out_ref.dtype).astype(f)
+        if with_errors:
+            ctr = new[k - s: k - s + bx]
+            for j in range(bx):
+                diff = jnp.abs(ctr[j] - sxct_ref[s - 1, i * bx + j] * syz)
+                dmax_ref[s - 1, i * bx + j] = jnp.max(diff)
+                rmax_ref[s - 1, i * bx + j] = jnp.max(diff * rsyz)
+        prev, cur = c, new
+
+    out_prev_ref[:] = prev.astype(out_prev_ref.dtype)
+    out_ref[:] = cur.astype(out_ref.dtype)
+
+
+def fused_kstep_sharded(u_prev, u, prev_ghosts, cur_ghosts, syz, rsyz, sxct,
+                        *, k, coeff, inv_h2, block_x=None, interpret=False,
+                        with_errors=True, compute_dtype=None):
+    """k temporally fused leapfrog steps of one x-sharded block.
+
+    Must run inside `shard_map` on a (P, 1, 1) mesh.  `u_prev`/`u` are the
+    local (N/P, N, N) block; `prev_ghosts`/`cur_ghosts` are ((k, N, N)
+    lo, hi) pairs ppermute'd from the cyclic x-neighbours BEFORE the call
+    (the reference's per-rank exchange-then-kernel shape,
+    mpi_new.cpp:327-352, with the exchange amortized over k layers).
+    `sxct` is this shard's (k, N/P) oracle row slice.  Returns the same
+    tuple as `fused_kstep` with (k, N/P)-local error rows.
+    """
+    nl = u.shape[0]
+    if compute_dtype is None:
+        compute_dtype = stencil_ref.compute_dtype(u.dtype)
+    if nl % k:
+        raise ValueError(f"k={k} must divide the shard depth {nl}")
+    bx = block_x or choose_kstep_block(
+        u.shape[1], k, u.dtype.itemsize, depth=nl, ghosts=True
+    )
+    if bx is None:
+        raise ValueError(
+            f"k={k} does not fit VMEM for {u.shape} shards"
+        )
+    if nl % bx or bx % k:
+        raise ValueError(f"block_x={bx} must divide the shard depth {nl} "
+                         f"and be a multiple of k={k}")
+    ny, nz = u.shape[1], u.shape[2]
+    slab = pl.BlockSpec((bx, ny, nz), lambda i: (i, 0, 0),
+                        memory_space=pltpu.VMEM)
+    nb = nl // k
+    lo = pl.BlockSpec((k, ny, nz),
+                      lambda i, _bk=bx // k, _nb=nb:
+                      ((i * _bk - 1) % _nb, 0, 0),
+                      memory_space=pltpu.VMEM)
+    hi = pl.BlockSpec((k, ny, nz),
+                      lambda i, _bk=bx // k, _nb=nb:
+                      (((i + 1) * _bk) % _nb, 0, 0),
+                      memory_space=pltpu.VMEM)
+    # Ghost operands: constant index map, so the pipeline fetches them once.
+    ghost = pl.BlockSpec((k, ny, nz), lambda i: (0, 0, 0),
+                         memory_space=pltpu.VMEM)
+    plane = pl.BlockSpec((ny, nz), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    kern = functools.partial(
+        _kstep_sharded_kernel, k=k, bx=bx, coeff=coeff, inv_h2=inv_h2,
+        compute_dtype=compute_dtype, with_errors=with_errors,
+    )
+    state = _out_struct(u)
+    out_specs = [slab, slab]
+    out_shape = [state, state]
+    if with_errors:
+        err = jax.ShapeDtypeStruct((k, nl), jnp.float32)
+        vma = getattr(getattr(u, "aval", None), "vma", None)
+        if vma:
+            err = jax.ShapeDtypeStruct((k, nl), jnp.float32, vma=vma)
+        out_specs += [smem, smem]
+        out_shape += [err, err]
+    out = pl.pallas_call(
+        kern,
+        grid=(nl // bx,),
+        in_specs=[smem, slab, slab, lo, hi, lo, hi,
+                  ghost, ghost, ghost, ghost, plane, plane],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_KSTEP_VMEM_LIMIT
+        ),
+        interpret=interpret,
+    )(sxct, u_prev, u, u_prev, u_prev, u, u,
+      prev_ghosts[0], prev_ghosts[1], cur_ghosts[0], cur_ghosts[1],
+      syz, rsyz)
     if with_errors:
         return out
     return out[0], out[1], None, None
