@@ -21,6 +21,7 @@ from wavetpu.solver import kfused, sharded_kfused
     (4, 4, 13),   # nl = 4 = k: every program is both edges
     (8, 2, 9),    # nl = 2: minimal shard depth
     (1, 4, 9),    # single-shard mesh == single-device data path
+    (2, 4, 12),   # (timesteps-1) % k == 3: exercises the 1-step remainder
 ])
 def test_state_matches_single_device_kfused(n_shards, k, timesteps):
     p = Problem(N=16, timesteps=timesteps)

@@ -40,9 +40,8 @@ from jax.sharding import PartitionSpec as P
 from wavetpu.core.grid import build_mesh
 from wavetpu.core.problem import Problem
 from wavetpu.kernels import stencil_pallas, stencil_ref
-from wavetpu.solver import leapfrog
+from wavetpu.solver import kfused, leapfrog
 from wavetpu.solver.leapfrog import SolveResult
-from wavetpu.verify import oracle
 
 
 def _validate(problem: Problem, k: int, n_shards: int):
@@ -60,24 +59,16 @@ def _validate(problem: Problem, k: int, n_shards: int):
 
 
 def _assemble_errors(problem, dmax_rows, rmax_rows, f):
-    """Global per-layer abs/rel errors from (layers, N) plane-max rows."""
-    n = problem.N
-    sx, _, _ = oracle.spatial_factors(problem, f)
-    absx = jnp.abs(sx)
-    xmask = jnp.asarray(np.arange(n) != 0)
-    inv_absx = jnp.where(
-        xmask & (absx != 0),
-        1.0 / jnp.where(absx == 0, jnp.asarray(1, f), absx),
-        jnp.asarray(0, f),
+    """Global per-layer abs/rel errors from (layers, N) plane-max rows.
+
+    Thin adapter over the single source of the error-rescale contract
+    (kfused._oracle_parts / _block_errors): the same exact-zero guards and
+    x!=0 interior mask, applied to all layers' rows at once (ctk is just
+    longer)."""
+    _, ct, _, _, xmask, inv_absx = kfused._oracle_parts(problem, f)
+    return kfused._block_errors(
+        dmax_rows, rmax_rows, ct[: dmax_rows.shape[0]], xmask, inv_absx
     )
-    ct = oracle.time_factor_table(problem, f)[: dmax_rows.shape[0]]
-    abs_e = jnp.max(jnp.where(xmask[None, :], dmax_rows, 0.0), axis=1)
-    rel_e = jnp.max(
-        jnp.where(xmask[None, :], rmax_rows * inv_absx[None, :], 0.0), axis=1
-    )
-    ict = jnp.abs(ct)
-    rel_e = jnp.where(ict != 0, rel_e / jnp.where(ict == 0, 1.0, ict), 0.0)
-    return abs_e, rel_e
 
 
 def _make_runner(
@@ -101,14 +92,8 @@ def _make_runner(
     """
     f = stencil_ref.compute_dtype(dtype)
     nl = problem.N // n_shards
-    sx, sy, sz = oracle.spatial_factors(problem, f)
-    ct = oracle.time_factor_table(problem, f)
+    sx, ct, syz, rsyz, _, _ = kfused._oracle_parts(problem, f)
     sxct_all = ct[:, None] * sx[None, :]            # (T+1, N)
-    syz = sy[:, None] * sz[None, :]
-    rsyz = jnp.abs(jnp.where(
-        syz == 0, jnp.asarray(0, f),
-        1.0 / jnp.where(syz == 0, jnp.asarray(1, f), syz),
-    ))
     perm_fwd = [(i, (i + 1) % n_shards) for i in range(n_shards)]
     perm_bwd = [(i, (i - 1) % n_shards) for i in range(n_shards)]
     coeff = problem.a2tau2
