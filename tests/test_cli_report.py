@@ -285,3 +285,18 @@ def test_cli_fuse_steps_auto_stays_single(tmp_path, capsys):
     assert rc == 0
     assert os.path.exists(tmp_path / "output_N20_Np1_TPU.txt")
     capsys.readouterr()
+
+
+def test_cli_debug_nans_flag(tmp_path):
+    """--debug-nans enables jax's NaN trap for the solve (SURVEY section 5
+    sanitizer row) and a stable run completes without a false trap."""
+    import jax
+
+    try:
+        rc = cli.main(["16", "1", "1", "1", "1", "1", "5",
+                       "--backend", "single", "--debug-nans",
+                       "--out-dir", str(tmp_path)])
+        assert rc == 0
+        assert jax.config.jax_debug_nans
+    finally:
+        jax.config.update("jax_debug_nans", False)

@@ -54,6 +54,12 @@ pick at runtime):
   --overlap                         overlap halo exchange with the bulk
                                     stencil update (sharded backend, even
                                     shard splits only)
+  --debug-nans                      enable jax debug_nans: the solve traps
+                                    on the first NaN instead of reporting
+                                    a garbage error norm (SURVEY section 5
+                                    sanitizer row - e.g. a Courant-unstable
+                                    config, or a VMEM overflow that
+                                    silently NaNs inside lax.scan)
   --distributed                     multi-process launch: call
                                     jax.distributed.initialize() (explicit
                                     JAX_COORDINATOR_ADDRESS /
@@ -86,9 +92,11 @@ _KNOWN_FLAGS = (
     "backend", "mesh", "dtype", "no-errors", "out-dir", "platform",
     "phase-timing", "stop-step", "save-state", "resume",
     "kernel", "overlap", "scheme", "distributed", "profile",
-    "fuse-steps",
+    "fuse-steps", "debug-nans",
 )
-_VALUELESS = ("no-errors", "phase-timing", "overlap", "distributed")
+_VALUELESS = (
+    "no-errors", "phase-timing", "overlap", "distributed", "debug-nans",
+)
 
 
 def resolve_kernel(flag_value: str, platform: str) -> str:
@@ -294,6 +302,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     platform = flags.get("platform") or os.environ.get("JAX_PLATFORMS")
     if platform and platform != jax.config.jax_platforms:
         jax.config.update("jax_platforms", platform)
+    if "debug-nans" in flags:
+        jax.config.update("jax_debug_nans", True)
 
     if distributed:
         dist_kwargs = {}

@@ -58,14 +58,14 @@ def _validate(problem: Problem, k: int, n_shards: int):
         )
 
 
-def _assemble_errors(problem, dmax_rows, rmax_rows, f):
+def _assemble_errors(oracle_parts, dmax_rows, rmax_rows):
     """Global per-layer abs/rel errors from (layers, N) plane-max rows.
 
     Thin adapter over the single source of the error-rescale contract
     (kfused._oracle_parts / _block_errors): the same exact-zero guards and
     x!=0 interior mask, applied to all layers' rows at once (ctk is just
     longer)."""
-    _, ct, _, _, xmask, inv_absx = kfused._oracle_parts(problem, f)
+    _, ct, _, _, xmask, inv_absx = oracle_parts
     return kfused._block_errors(
         dmax_rows, rmax_rows, ct[: dmax_rows.shape[0]], xmask, inv_absx
     )
@@ -92,7 +92,8 @@ def _make_runner(
     """
     f = stencil_ref.compute_dtype(dtype)
     nl = problem.N // n_shards
-    sx, ct, syz, rsyz, _, _ = kfused._oracle_parts(problem, f)
+    oracle_parts = kfused._oracle_parts(problem, f)
+    sx, ct, syz, rsyz, _, _ = oracle_parts
     sxct_all = ct[:, None] * sx[None, :]            # (T+1, N)
     perm_fwd = [(i, (i + 1) % n_shards) for i in range(n_shards)]
     perm_bwd = [(i, (i - 1) % n_shards) for i in range(n_shards)]
@@ -194,7 +195,7 @@ def _make_runner(
             )
             u_prev, u, dmax, rmax = local_fn(u0, sxct_all)
             if compute_errors:
-                abs_e, rel_e = _assemble_errors(problem, dmax, rmax, f)
+                abs_e, rel_e = _assemble_errors(oracle_parts, dmax, rmax)
             else:
                 abs_e = rel_e = jnp.zeros((nsteps + 1,), f)
             return u_prev, u, abs_e, rel_e
@@ -222,7 +223,7 @@ def _make_runner(
     def run(u_prev, u):
         u_prev, u, dmax, rmax = local_fn(u_prev, u, sxct_all)
         if compute_errors:
-            abs_e, rel_e = _assemble_errors(problem, dmax, rmax, f)
+            abs_e, rel_e = _assemble_errors(oracle_parts, dmax, rmax)
         else:
             abs_e = rel_e = jnp.zeros((nsteps + 1,), f)
         return u_prev, u, abs_e, rel_e
