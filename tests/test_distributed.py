@@ -26,7 +26,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch(pid: int, out_dir: str, port: int):
+def _launch(pid: int, out_dir: str, port: int, extra=()):
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # 1 local CPU device per process
     env.update(
@@ -40,6 +40,7 @@ def _launch(pid: int, out_dir: str, port: int):
             sys.executable, "-m", "wavetpu.cli",
             "16", "1", "1", "1", "1", "1", "5",
             "--distributed", "--mesh", "2,1,1", "--out-dir", out_dir,
+            *extra,
         ],
         env=env,
         stdout=subprocess.PIPE,
@@ -78,6 +79,36 @@ def test_two_process_cli_writes_one_report(tmp_path):
     side = json.load(open(os.path.join(out0, "output_N16_Np2_TPU.json")))
     local = sharded.solve_sharded(
         Problem(N=16, timesteps=5), mesh_shape=(2, 1, 1)
+    )
+    np.testing.assert_allclose(
+        side["abs_errors"], local.abs_errors, rtol=1e-5, atol=1e-8
+    )
+
+
+def test_two_process_kfused(tmp_path):
+    """The x-sharded k-fused solver also runs multi-process: 2 OS
+    processes, 1 device each, --fuse-steps 2, rank-0 gating intact and
+    errors matching the in-process run."""
+    from wavetpu.solver import sharded_kfused
+
+    out0 = str(tmp_path / "p0")
+    out1 = str(tmp_path / "p1")
+    os.makedirs(out0)
+    os.makedirs(out1)
+    port = _free_port()
+    extra = ("--fuse-steps", "2")
+    procs = [
+        _launch(0, out0, port, extra), _launch(1, out1, port, extra)
+    ]
+    outs = [p.communicate(timeout=240)[0] for p in procs]
+    assert procs[0].returncode == 0, outs[0]
+    assert procs[1].returncode == 0, outs[1]
+    assert os.listdir(out1) == []
+    assert "fuse-steps: 2" in outs[0]
+
+    side = json.load(open(os.path.join(out0, "output_N16_Np2_TPU.json")))
+    local = sharded_kfused.solve_sharded_kfused(
+        Problem(N=16, timesteps=5), n_shards=2, k=2, interpret=True
     )
     np.testing.assert_allclose(
         side["abs_errors"], local.abs_errors, rtol=1e-5, atol=1e-8

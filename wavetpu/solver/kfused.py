@@ -23,6 +23,14 @@ The reference has no counterpart to fuse-k (every variant launches one
 kernel per layer with a global sync between); SURVEY.md section 7's perf
 plan called the HBM stream count the budget to beat, and this is the
 mechanism that beats it.
+
+Scope: constant wave speed, standard scheme.  Variable-c would add the
+c^2tau^2 field's own onion (slab + k-plane halos) to the pipeline - at
+N=512 that pushes every k>=2 config over the VMEM budget or down to
+block sizes whose (3 fields + 2k halos)/k traffic per step equals the
+1-step variable-c kernel's, i.e. no win to ship.  The compensated (Kahan)
+scheme triples the state (u, v, carry) with the same conclusion.  Both
+remain available at full speed through their 1-step kernels.
 """
 
 from __future__ import annotations
