@@ -174,10 +174,23 @@ def test_cli_fuse_steps_validation(capsys):
     assert cli.main(
         base + ["--fuse-steps", "4", "--scheme", "compensated"]
     ) == 2
-    assert cli.main(base + ["--fuse-steps", "4", "--phase-timing"]) == 2
     assert cli.main(["18", "1", "1", "1", "1", "1", "5",
                      "--fuse-steps", "4"]) == 2  # 4 does not divide 18
     capsys.readouterr()
+
+
+def test_cli_fuse_steps_phase_timing(tmp_path, capsys):
+    """--phase-timing probes the k-fused program (k-blocks, scaled to the
+    layers they cover) and lands in the report like the 1-step probe."""
+    rc = cli.main(
+        ["16", "1", "1", "1", "1", "1", "8", "--fuse-steps", "4",
+         "--mesh", "2,1,1", "--phase-timing", "--out-dir", str(tmp_path)]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "total loop time:" in out and "total ICI exchange time:" in out
+    text = open(tmp_path / "output_N16_Np2_TPU.txt").read()
+    assert "total loop time:" in text
 
 
 def test_cli_fuse_steps_resume_guards(tmp_path, capsys):
@@ -300,3 +313,23 @@ def test_cli_debug_nans_flag(tmp_path):
         assert jax.config.jax_debug_nans
     finally:
         jax.config.update("jax_debug_nans", False)
+
+
+def test_cli_resumed_kfused_phase_timing_uses_checkpoint_mesh(
+    tmp_path, capsys
+):
+    """A resumed sharded k-fused run probes the CHECKPOINT's mesh, not the
+    host's device count (N=16 on 8 devices would not even divide)."""
+    base = ["16", "1", "1", "1", "1", "1", "8", "--mesh", "2,1,1",
+            "--fuse-steps", "4"]
+    ck = str(tmp_path / "ck")
+    assert cli.main(
+        base + ["--stop-step", "4", "--save-state", ck,
+                "--out-dir", str(tmp_path)]
+    ) == 0
+    rc = cli.main(
+        ["--resume", ck, "--fuse-steps", "4", "--phase-timing",
+         "--out-dir", str(tmp_path / "res")]
+    )
+    assert rc == 0
+    assert "total loop time:" in capsys.readouterr().out

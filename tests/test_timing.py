@@ -57,3 +57,23 @@ def test_phase_breakdown_pallas_kernel(small_problem):
         iters=2, repeats=1,
     )
     assert pb.loop_seconds > 0.0
+
+
+def test_phase_breakdown_kfused(small_problem):
+    """fuse_steps > 1 probes the x-sharded k-fused program: k-block scans
+    with and without ppermute ghosts, scaled by the layers covered."""
+    pb = timing.measure_phase_breakdown(
+        small_problem, mesh_shape=(2, 1, 1), fuse_steps=4,
+        iters=2, repeats=1,
+    )
+    assert pb.loop_seconds > 0.0
+    assert pb.exchange_seconds >= 0.0
+    assert pb.steps_measured == 8  # 2 blocks x k=4 layers
+
+
+def test_phase_breakdown_kfused_rejects_3d_mesh(small_problem):
+    with pytest.raises(ValueError, match="x-only"):
+        timing.measure_phase_breakdown(
+            small_problem, mesh_shape=(2, 2, 1), fuse_steps=4,
+            iters=1, repeats=1,
+        )

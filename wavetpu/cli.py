@@ -185,11 +185,6 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     "--fuse-steps (whose exchange is amortized over k "
                     "layers)"
                 )
-            if "phase-timing" in flags:
-                raise ValueError(
-                    "--phase-timing probes the 1-step program; it is not "
-                    "available with --fuse-steps"
-                )
         if flags.get("backend") == "single" and "mesh" in flags:
             raise ValueError("--mesh contradicts --backend single")
         if flags.get("backend") == "single" and "overlap" in flags:
@@ -633,12 +628,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if "phase-timing" in flags:
         from wavetpu.solver import timing
 
+        # `shape` is the mesh the solve actually ran on (incl. a resumed
+        # checkpoint's mesh); the probe must time the same program.
         pb = timing.measure_phase_breakdown(
             problem,
-            mesh_shape=mesh_shape if backend == "sharded" else (1, 1, 1),
+            mesh_shape=shape if backend == "sharded" else (1, 1, 1),
             dtype=dtype,
             kernel=kernel,
             overlap=overlap,
+            fuse_steps=fuse_steps,
         )
         exchange_seconds, loop_seconds = pb.exchange_seconds, pb.loop_seconds
         probe_steps = pb.steps_measured

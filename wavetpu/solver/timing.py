@@ -41,6 +41,7 @@ from typing import Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from wavetpu.core.grid import AXIS_NAMES, Topology, build_mesh, choose_mesh_shape
@@ -115,6 +116,60 @@ def _time_best(fn, args, repeats: int) -> float:
     return best
 
 
+def _kfused_probe_runner(problem, n_shards, mesh, dtype, k, interpret,
+                         with_halo, iters: int):
+    """Jitted scan of `iters` PRODUCTION k-blocks over x-sharded state.
+
+    `with_halo=False` substitutes the shard's own wrap planes for the
+    ppermute'd ghosts - identical FLOPs and kernel, no ICI - mirroring
+    `_probe_runner`'s exchange=False contract for the k-fused solver
+    (whose exchange is one k-plane ppermute pair per field per k layers).
+    """
+    from wavetpu.solver import kfused as _kfused
+    from wavetpu.kernels import stencil_pallas as _sp
+
+    f = stencil_ref.compute_dtype(dtype)
+    nl = problem.N // n_shards
+    _, _, syz, rsyz, _, _ = _kfused._oracle_parts(problem, f)
+    perm_fwd = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    perm_bwd = [(i, (i - 1) % n_shards) for i in range(n_shards)]
+
+    def local(u_prev, u, salt):
+        def ghosts(a):
+            if with_halo:
+                return (
+                    lax.ppermute(a[-k:], "x", perm_fwd),
+                    lax.ppermute(a[:k], "x", perm_bwd),
+                )
+            return a[-k:], a[:k]
+
+        def body(carry, _):
+            u_prev, u = carry
+            up, uc, _, _ = _sp.fused_kstep_sharded(
+                u_prev, u, ghosts(u_prev), ghosts(u), syz, rsyz,
+                jnp.zeros((k, nl), f), k=k, coeff=problem.a2tau2,
+                inv_h2=problem.inv_h2, interpret=interpret,
+                with_errors=False,
+            )
+            return (up, uc), None
+
+        (u_prev, u), _ = jax.lax.scan(
+            body, (u_prev + salt, u), None, length=iters
+        )
+        return jax.lax.psum(jnp.sum(u), AXIS_NAMES)
+
+    spec = P("x")
+    return jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(spec, spec, P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+
+
 def measure_phase_breakdown(
     problem: Problem,
     mesh_shape: Optional[Tuple[int, int, int]] = None,
@@ -125,12 +180,15 @@ def measure_phase_breakdown(
     interpret: Optional[bool] = None,
     iters: int = 10,
     repeats: int = 3,
+    fuse_steps: int = 1,
 ) -> PhaseBreakdown:
     """Measure the loop/exchange split and scale it to the full solve length.
 
     Runs on zero state - leapfrog cost is data-independent, and the probes
     exist for timing, not numerics.  `kernel`/`overlap` select the same
-    step the production solver would run.
+    step the production solver would run; `fuse_steps > 1` probes the
+    x-sharded k-fused program instead (mesh must be x-only; `iters` then
+    counts k-blocks and the breakdown is scaled by the layers they cover).
     """
     if devices is None:
         devices = jax.devices()
@@ -138,6 +196,41 @@ def measure_phase_breakdown(
         mesh_shape = choose_mesh_shape(len(devices))
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if fuse_steps > 1:
+        from wavetpu.solver import sharded_kfused as _skf
+
+        k = fuse_steps
+        n_shards = mesh_shape[0]
+        if mesh_shape[1:] != (1, 1):
+            raise ValueError(
+                f"k-fused probe needs an x-only mesh, got {mesh_shape}"
+            )
+        _skf._validate(problem, k, n_shards)  # same errors as production
+        mesh = build_mesh(mesh_shape, devices[:n_shards])
+        nl = problem.N // n_shards
+        sharding = jax.sharding.NamedSharding(mesh, P("x"))
+        u_prev = jax.device_put(
+            jnp.zeros((problem.N,) * 3, dtype), sharding
+        )
+        u = jax.device_put(jnp.zeros((problem.N,) * 3, dtype), sharding)
+        t_full = _time_best(
+            _kfused_probe_runner(
+                problem, n_shards, mesh, dtype, k, interpret, True, iters
+            ),
+            (u_prev, u), repeats,
+        )
+        t_comp = _time_best(
+            _kfused_probe_runner(
+                problem, n_shards, mesh, dtype, k, interpret, False, iters
+            ),
+            (u_prev, u), repeats,
+        )
+        scale = problem.timesteps / (iters * k)
+        return PhaseBreakdown(
+            loop_seconds=t_comp * scale,
+            exchange_seconds=max(0.0, (t_full - t_comp)) * scale,
+            steps_measured=iters * k,
+        )
     topo = Topology(N=problem.N, mesh_shape=mesh_shape)
     mesh = build_mesh(mesh_shape, devices[: topo.n_devices])
 
