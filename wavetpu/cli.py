@@ -209,7 +209,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "usage: wavetpu N Np Lx Ly Lz [T] [timesteps] "
             "[--backend auto|single|sharded] [--mesh MX,MY,MZ] "
             "[--dtype f32|f64|bf16] [--kernel auto|roll|pallas] "
-            "[--overlap] [--no-errors] [--out-dir DIR] [--platform NAME]",
+            "[--fuse-steps K] [--scheme standard|compensated] "
+            "[--overlap] [--no-errors] [--phase-timing] [--profile DIR] "
+            "[--debug-nans] [--distributed] [--stop-step S] "
+            "[--save-state PATH] [--resume PATH] "
+            "[--out-dir DIR] [--platform NAME]",
             file=sys.stderr,
         )
         return 2
