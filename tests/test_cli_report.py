@@ -201,7 +201,7 @@ def test_cli_fuse_steps_resume_guards(tmp_path, capsys):
     base = ["16", "1", "1", "1", "1", "1", "8"]
     shard_ck = str(tmp_path / "shard_ck")
     assert cli.main(
-        base + ["--mesh", "1,2,1", "--stop-step", "3",
+        base + ["--mesh", "1,1,2", "--stop-step", "3",
                 "--save-state", shard_ck, "--out-dir", str(tmp_path)]
     ) == 0
     assert cli.main(["--resume", shard_ck, "--fuse-steps", "4"]) == 2
@@ -213,14 +213,17 @@ def test_cli_fuse_steps_resume_guards(tmp_path, capsys):
     ) == 0
     assert cli.main(["--resume", comp_ck, "--fuse-steps", "4"]) == 2
     err = capsys.readouterr().err
-    assert "x-only" in err and "compensated" in err
+    assert "(MX,MY,1)" in err and "compensated" in err
 
 
 def test_cli_fuse_steps_sharded(tmp_path, capsys):
-    """--fuse-steps + --mesh MX,1,1 runs the x-sharded k-fused solver and
-    matches the single-device k-fused report; y/z meshes are rejected."""
+    """--fuse-steps + --mesh MX,MY,1 runs the sharded k-fused solver and
+    matches the single-device k-fused report; z-sharded meshes are
+    rejected."""
     base = ["16", "1", "1", "1", "1", "1", "9"]
-    one_dir, sh_dir = str(tmp_path / "one"), str(tmp_path / "sh")
+    one_dir, sh_dir, xy_dir = (
+        str(tmp_path / d) for d in ("one", "sh", "xy")
+    )
     assert cli.main(
         base + ["--fuse-steps", "4", "--out-dir", one_dir,
                 "--backend", "single"]
@@ -229,11 +232,17 @@ def test_cli_fuse_steps_sharded(tmp_path, capsys):
         base + ["--fuse-steps", "4", "--mesh", "2,1,1",
                 "--out-dir", sh_dir]
     ) == 0
-    assert cli.main(base + ["--fuse-steps", "4", "--mesh", "2,2,1"]) == 2
+    assert cli.main(
+        base + ["--fuse-steps", "4", "--mesh", "2,2,1",
+                "--out-dir", xy_dir]
+    ) == 0
+    assert cli.main(base + ["--fuse-steps", "4", "--mesh", "2,1,2"]) == 2
     capsys.readouterr()
     one = json.load(open(os.path.join(one_dir, "output_N16_Np1_TPU.json")))
     sh = json.load(open(os.path.join(sh_dir, "output_N16_Np2_TPU.json")))
+    xy = json.load(open(os.path.join(xy_dir, "output_N16_Np4_TPU.json")))
     assert sh["abs_errors"] == pytest.approx(one["abs_errors"], rel=1e-5)
+    assert xy["abs_errors"] == pytest.approx(one["abs_errors"], rel=1e-5)
 
 
 def test_cli_fuse_steps_sharded_resume(tmp_path, capsys):
@@ -333,3 +342,20 @@ def test_cli_resumed_kfused_phase_timing_uses_checkpoint_mesh(
     )
     assert rc == 0
     assert "total loop time:" in capsys.readouterr().out
+
+
+def test_cli_resumed_xy_kfused_phase_timing_rejected_presolve(
+    tmp_path, capsys
+):
+    """--phase-timing with a 2D-mesh k-fused checkpoint must fail BEFORE
+    the (potentially long) resume solve, with a clean exit."""
+    ck = str(tmp_path / "ck")
+    assert cli.main(
+        ["16", "1", "1", "1", "1", "1", "8", "--fuse-steps", "4",
+         "--mesh", "2,2,1", "--stop-step", "4", "--save-state", ck,
+         "--out-dir", str(tmp_path)]
+    ) == 0
+    assert cli.main(
+        ["--resume", ck, "--fuse-steps", "4", "--phase-timing"]
+    ) == 2
+    assert "x-only" in capsys.readouterr().err

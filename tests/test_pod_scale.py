@@ -56,6 +56,17 @@ _SCRIPT = textwrap.dedent("""
         atol=1e-5, rtol=0,
     )
     print("kfused mesh (64,1,1) OK")
+
+    # 2D decomposition under k-fusion: the flagship pod shape family
+    # ((8,8,1) factors v5e-64 without cutting the z lane dimension).
+    res3 = sharded_kfused.solve_sharded_kfused(
+        p2, mesh_shape=(8, 8, 1), k=2, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(res3.u_cur), np.asarray(single2.u_cur),
+        atol=1e-5, rtol=0,
+    )
+    print("kfused mesh (8,8,1) OK")
 """)
 
 
@@ -73,3 +84,4 @@ def test_64_device_meshes():
     )
     assert "mesh (4,4,4) x 64 devices OK" in proc.stdout
     assert "kfused mesh (64,1,1) OK" in proc.stdout
+    assert "kfused mesh (8,8,1) OK" in proc.stdout

@@ -47,9 +47,10 @@ pick at runtime):
                                     per-layer errors still reported).
                                     Requires the pallas kernel, the standard
                                     scheme, and K | N/MX; single device or an
-                                    x-only mesh (--mesh MX,1,1 ->
-                                    solver/sharded_kfused.py, K-plane ghost
-                                    exchange per K layers); layers are
+                                    (MX,MY,1) mesh (--mesh ->
+                                    solver/sharded_kfused.py, K-deep ghost
+                                    exchange per K layers, corners via
+                                    sequenced y-then-x ppermute); layers are
                                     bitwise identical to K=1
   --overlap                         overlap halo exchange with the bulk
                                     stencil update (sharded backend, even
@@ -168,16 +169,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     "scheme"
                 )
             if "mesh" in flags:
-                # k-fusion composes with x-only decomposition (the y/z
-                # rolls must stay full-domain, solver/sharded_kfused.py).
+                # k-fusion composes with (MX, MY, 1) decompositions; z is
+                # the lane dimension and stays whole
+                # (solver/sharded_kfused.py).
                 try:
                     _m = tuple(int(x) for x in flags["mesh"].split(","))
                 except ValueError:
                     _m = ()
-                if len(_m) == 3 and (_m[1:] != (1, 1) or _m[0] < 1):
+                if len(_m) == 3 and (
+                    _m[2] != 1 or _m[0] < 1 or _m[1] < 1
+                ):
                     raise ValueError(
-                        "--fuse-steps supports x-only meshes (MX,1,1, "
-                        f"MX >= 1); got {flags['mesh']}"
+                        "--fuse-steps supports (MX,MY,1) meshes "
+                        f"(MX, MY >= 1, MZ = 1); got {flags['mesh']}"
+                    )
+                if len(_m) == 3 and _m[1] > 1 and "phase-timing" in flags:
+                    raise ValueError(
+                        "--phase-timing's k-fused probe covers x-only "
+                        "meshes; drop it or use --mesh MX,1,1"
                     )
             if "overlap" in flags:
                 raise ValueError(
@@ -249,10 +258,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         file=sys.stderr,
                     )
                     return 2
-                if fuse_steps > 1 and _ck_mesh[1:] != (1, 1):
+                if fuse_steps > 1 and _ck_mesh[2] != 1:
                     print(
-                        f"error: --fuse-steps supports x-only meshes; the "
-                        f"checkpoint was saved on {_ck_mesh}",
+                        f"error: --fuse-steps supports (MX,MY,1) meshes; "
+                        f"the checkpoint was saved on {_ck_mesh}",
+                        file=sys.stderr,
+                    )
+                    return 2
+                if (
+                    fuse_steps > 1 and _ck_mesh[1] > 1
+                    and "phase-timing" in flags
+                ):
+                    # Same pre-solve placement as the explicit --mesh
+                    # check: the probe must not fail AFTER a long solve.
+                    print(
+                        "error: --phase-timing's k-fused probe covers "
+                        f"x-only meshes; the checkpoint was saved on "
+                        f"{_ck_mesh}",
                         file=sys.stderr,
                     )
                     return 2
@@ -365,14 +387,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             or flags.get("backend") == "sharded"
         )
         backend = "sharded" if explicit_sharded else "single"
-        n_x_shards = (mesh_shape or (_ck_mesh if resume_is_sharded else None)
-                      or (n_devices, 1, 1))[0] if backend == "sharded" else 1
-        if problem.N % n_x_shards or (
-            problem.N // n_x_shards
-        ) % fuse_steps:
+        _grid = (
+            (mesh_shape or (_ck_mesh if resume_is_sharded else None)
+             or (n_devices, 1, 1)) if backend == "sharded" else (1, 1, 1)
+        )
+        if (
+            problem.N % _grid[0]
+            or (problem.N // _grid[0]) % fuse_steps
+            or problem.N % _grid[1]
+            or problem.N // _grid[1] < fuse_steps
+        ):
             print(
                 f"error: --fuse-steps {fuse_steps} must divide the "
-                f"per-shard depth N/MX = {problem.N}/{n_x_shards}",
+                f"per-shard x depth N/MX = {problem.N}/{_grid[0]} and "
+                f"fit the y depth N/MY = {problem.N}/{_grid[1]}",
                 file=sys.stderr,
             )
             return 2
@@ -459,7 +487,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 _u_prev0,
                 _u_cur0,
                 start_step=_start,
-                n_shards=_ck_mesh[0],
+                mesh_shape=_ck_mesh,
                 dtype=resume_dtype,
                 k=fuse_steps,
                 compute_errors=compute_errors,
@@ -469,7 +497,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             shape = mesh_shape or (n_devices, 1, 1)
             result = sharded_kfused.solve_sharded_kfused(
                 problem,
-                n_shards=shape[0],
+                mesh_shape=shape,
                 dtype=dtype,
                 k=fuse_steps,
                 compute_errors=compute_errors,

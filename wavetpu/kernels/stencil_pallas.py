@@ -428,13 +428,16 @@ def _append_ghosts(in_specs, operands, specs, need, ghosts):
             operands += [g_lo, g_hi]
 
 
-def _out_struct(u):
-    """Output aval matching the state it replaces; under shard_map with
-    check_vma it must declare which mesh axes it varies over."""
+def _out_struct(u, shape=None, dtype=None):
+    """Output aval matching the state it replaces (or the given
+    shape/dtype override); under shard_map with check_vma it must declare
+    which mesh axes it varies over."""
+    shape = u.shape if shape is None else shape
+    dtype = u.dtype if dtype is None else dtype
     vma = getattr(getattr(u, "aval", None), "vma", None)
     if vma:
-        return jax.ShapeDtypeStruct(u.shape, u.dtype, vma=vma)
-    return jax.ShapeDtypeStruct(u.shape, u.dtype)
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def sharded_fused_step(u_prev, u, ghosts, offsets, n_global, *, inv_h2,
@@ -645,7 +648,7 @@ _KSTEP_VMEM_BUDGET = 122 * 1024 * 1024
 
 def choose_kstep_block(
     n: int, k: int, itemsize: int = 4, depth: Optional[int] = None,
-    ghosts: bool = False,
+    ghosts: bool = False, plane_elems: Optional[int] = None,
 ) -> Optional[int]:
     """Largest slab depth bx (multiple of k, power-of-two steps, <= 8,
     dividing `depth`) whose k-step pipeline fits VMEM; None if even bx=k
@@ -661,8 +664,10 @@ def choose_kstep_block(
     """
     if depth is None:
         depth = n
-    pb_state = n * n * itemsize
-    pb_f32 = n * n * 4
+    if plane_elems is None:
+        plane_elems = n * n
+    pb_state = plane_elems * itemsize
+    pb_f32 = plane_elems * 4
     best = None
     bx = k
     while bx <= 8 and bx <= depth:
@@ -931,10 +936,7 @@ def fused_kstep_sharded(u_prev, u, prev_ghosts, cur_ghosts, syz, rsyz, sxct,
     out_specs = [slab, slab]
     out_shape = [state, state]
     if with_errors:
-        err = jax.ShapeDtypeStruct((k, nl), jnp.float32)
-        vma = getattr(getattr(u, "aval", None), "vma", None)
-        if vma:
-            err = jax.ShapeDtypeStruct((k, nl), jnp.float32, vma=vma)
+        err = _out_struct(u, shape=(k, nl), dtype=jnp.float32)
         out_specs += [smem, smem]
         out_shape += [err, err]
     out = pl.pallas_call(
@@ -951,6 +953,171 @@ def fused_kstep_sharded(u_prev, u, prev_ghosts, cur_ghosts, syz, rsyz, sxct,
     )(sxct, u_prev, u, u_prev, u_prev, u, u,
       prev_ghosts[0], prev_ghosts[1], cur_ghosts[0], cur_ghosts[1],
       syz, rsyz)
+    if with_errors:
+        return out
+    return out[0], out[1], None, None
+
+
+def _kstep_sharded_xy_kernel(off_ref, sxct_ref, uprev_ref, uc_ref, plo_ref,
+                             phi_ref, lo_ref, hi_ref, pglo_ref, pghi_ref,
+                             glo_ref, ghi_ref, syzc_ref, rsyzc_ref,
+                             *out_refs, k, bx, nl_y, n_global, coeff, inv_h2,
+                             compute_dtype, with_errors):
+    """`_kstep_sharded_kernel` for blocks ALSO sharded along y.
+
+    The solver hands in blocks pre-extended in y by k ghost rows per side
+    (width W = nl_y + 2k), so the in-VMEM y rolls behave exactly as on the
+    full domain for every row the onion still considers valid: staleness
+    creeps inward one row per substep from the ghost edges and never
+    reaches the central nl_y rows that are written back.  Two deltas vs
+    the x-only kernel:
+
+     * the y Dirichlet mask tests the WRAPPED global row index
+       ((y0 - k + row) mod N != 0): the global y=0 stored zero plane must
+       be re-zeroed wherever it appears, including inside a ghost strip,
+       or its evolved copy would leak nonzero values into real rows;
+     * outputs and error maxes slice the central y rows.
+    """
+    if with_errors:
+        out_prev_ref, out_ref, dmax_ref, rmax_ref = out_refs
+    else:
+        out_prev_ref, out_ref = out_refs
+    i = pl.program_id(0)
+    last = pl.num_programs(0) - 1
+    f = compute_dtype
+    ix, iy, iz = (jnp.asarray(v, f) for v in inv_h2)
+
+    def pick(edge_is_lo, ghost_ref, wrap_ref):
+        at_edge = (i == 0) if edge_is_lo else (i == last)
+        return jnp.where(
+            at_edge, ghost_ref[:].astype(f), wrap_ref[:].astype(f)
+        )
+
+    prev = jnp.concatenate([
+        pick(True, pglo_ref, plo_ref),
+        uprev_ref[:].astype(f),
+        pick(False, pghi_ref, phi_ref),
+    ], 0)
+    cur = jnp.concatenate([
+        pick(True, glo_ref, lo_ref),
+        uc_ref[:].astype(f),
+        pick(False, ghi_ref, hi_ref),
+    ], 0)
+    w, nz = cur.shape[1], cur.shape[2]
+
+    gy = (off_ref[0] - k + lax.broadcasted_iota(jnp.int32, (1, w, nz), 1))
+    gy = gy % n_global
+    zm = lax.broadcasted_iota(jnp.int32, (1, w, nz), 2) != 0
+    mask = (gy != 0) & zm
+
+    for s in range(1, k + 1):
+        c = cur[1:-1]
+        lap = (cur[:-2] + cur[2:] - 2.0 * c) * ix
+        lap = lap + (
+            pltpu.roll(c, 1, 1) + pltpu.roll(c, w - 1, 1) - 2.0 * c
+        ) * iy
+        lap = lap + (
+            pltpu.roll(c, 1, 2) + pltpu.roll(c, nz - 1, 2) - 2.0 * c
+        ) * iz
+        new = 2.0 * c + jnp.asarray(coeff, f) * lap - prev[1:-1]
+        new = jnp.where(mask, new, jnp.asarray(0.0, f))
+        if out_ref.dtype != f:
+            new = new.astype(out_ref.dtype).astype(f)
+        if with_errors:
+            ctr = new[k - s: k - s + bx, k: k + nl_y]
+            syz = syzc_ref[:]
+            rsyz = rsyzc_ref[:]
+            for j in range(bx):
+                diff = jnp.abs(ctr[j] - sxct_ref[s - 1, i * bx + j] * syz)
+                dmax_ref[s - 1, i * bx + j] = jnp.max(diff)
+                rmax_ref[s - 1, i * bx + j] = jnp.max(diff * rsyz)
+        prev, cur = c, new
+
+    out_prev_ref[:] = prev[:, k: k + nl_y].astype(out_prev_ref.dtype)
+    out_ref[:] = cur[:, k: k + nl_y].astype(out_ref.dtype)
+
+
+def fused_kstep_sharded_xy(u_prev_ext, u_ext, prev_ghosts, cur_ghosts,
+                           syz_c, rsyz_c, sxct, y0, n_global, *,
+                           k, nl_y, coeff, inv_h2, block_x=None,
+                           interpret=False, with_errors=True,
+                           compute_dtype=None):
+    """k fused leapfrog steps of an (x, y)-sharded block.
+
+    Must run inside `shard_map` on a (P, Q, 1) mesh.  `u_prev_ext`/`u_ext`
+    are the local blocks pre-extended along y with k ghost rows per side
+    (comm: one cyclic y-ppermute pair per field); `prev_ghosts`/`cur_ghosts`
+    are ((k, W, nz) lo, hi) x-ghost pairs ppermute'd FROM THE EXTENDED
+    blocks - which is what makes the diagonal corner regions arrive for
+    free.  `syz_c`/`rsyz_c` are the central (nl_y, nz) oracle plane
+    slices, `sxct` this shard's (k, nl_x) oracle rows, `y0` the shard's
+    global y offset as an int32 scalar array.  Returns central
+    (nl_x, nl_y, nz) layers + (k, nl_x) error rows (max over this shard's
+    y range; callers pmax over the y mesh axis).
+    """
+    nl_x, w, nz = u_ext.shape
+    if compute_dtype is None:
+        compute_dtype = stencil_ref.compute_dtype(u_ext.dtype)
+    if w != nl_y + 2 * k:
+        raise ValueError(
+            f"extended y width {w} != nl_y + 2k = {nl_y + 2 * k}"
+        )
+    if nl_x % k:
+        raise ValueError(f"k={k} must divide the shard depth {nl_x}")
+    bx = block_x or choose_kstep_block(
+        nz, k, u_ext.dtype.itemsize, depth=nl_x, ghosts=True,
+        plane_elems=w * nz,
+    )
+    if bx is None:
+        raise ValueError(f"k={k} does not fit VMEM for {u_ext.shape}")
+    if nl_x % bx or bx % k:
+        raise ValueError(f"block_x={bx} must divide the shard depth "
+                         f"{nl_x} and be a multiple of k={k}")
+    slab = pl.BlockSpec((bx, w, nz), lambda i: (i, 0, 0),
+                        memory_space=pltpu.VMEM)
+    nb = nl_x // k
+    lo = pl.BlockSpec((k, w, nz),
+                      lambda i, _bk=bx // k, _nb=nb:
+                      ((i * _bk - 1) % _nb, 0, 0),
+                      memory_space=pltpu.VMEM)
+    hi = pl.BlockSpec((k, w, nz),
+                      lambda i, _bk=bx // k, _nb=nb:
+                      (((i + 1) * _bk) % _nb, 0, 0),
+                      memory_space=pltpu.VMEM)
+    ghost = pl.BlockSpec((k, w, nz), lambda i: (0, 0, 0),
+                         memory_space=pltpu.VMEM)
+    out_slab = pl.BlockSpec((bx, nl_y, nz), lambda i: (i, 0, 0),
+                            memory_space=pltpu.VMEM)
+    plane = pl.BlockSpec((nl_y, nz), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM)
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    kern = functools.partial(
+        _kstep_sharded_xy_kernel, k=k, bx=bx, nl_y=nl_y,
+        n_global=n_global, coeff=coeff, inv_h2=inv_h2,
+        compute_dtype=compute_dtype, with_errors=with_errors,
+    )
+    state = _out_struct(u_ext, shape=(nl_x, nl_y, nz))
+    out_specs = [out_slab, out_slab]
+    out_shape = [state, state]
+    if with_errors:
+        err = _out_struct(u_ext, shape=(k, nl_x), dtype=jnp.float32)
+        out_specs += [smem, smem]
+        out_shape += [err, err]
+    out = pl.pallas_call(
+        kern,
+        grid=(nl_x // bx,),
+        in_specs=[smem, smem, slab, slab, lo, hi, lo, hi,
+                  ghost, ghost, ghost, ghost, plane, plane],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_KSTEP_VMEM_LIMIT
+        ),
+        interpret=interpret,
+    )(jnp.asarray(y0, jnp.int32).reshape(1), sxct,
+      u_prev_ext, u_ext, u_prev_ext, u_prev_ext, u_ext, u_ext,
+      prev_ghosts[0], prev_ghosts[1], cur_ghosts[0], cur_ghosts[1],
+      syz_c, rsyz_c)
     if with_errors:
         return out
     return out[0], out[1], None, None

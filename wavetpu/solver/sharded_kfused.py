@@ -1,34 +1,43 @@
-"""Temporally fused k-step solver over an x-sharded device mesh.
+"""Temporally fused k-step solver over an (MX, MY, 1)-sharded device mesh.
 
 Composes the repo's two flagship mechanisms: the k-step VMEM-onion kernel
 (solver/kfused.py - the single-chip HBM-traffic win) and the shard_map
 decomposition with ppermute halo exchange (solver/sharded.py - the
-reference's MPI role, mpi_new.cpp:324-372).  The decomposition is x-only
-((P, 1, 1) mesh, N % P == 0): each shard owns a contiguous slab of
-x-planes with y/z full-domain, so the in-kernel y/z rolls and Dirichlet
-mask are exactly the single-device kernel's, and one cyclic ppermute pair
-per field delivers the k boundary planes a k-block needs.  Exchanging k
-planes per k LAYERS also amortizes the per-step latency cost of the
-reference's per-layer exchange (mpi_new.cpp:327-352) by k - halo BYTES
-per layer stay the same, messages drop k-fold.
+reference's MPI role, mpi_new.cpp:324-372).  Exchanging k-deep ghosts per
+k LAYERS amortizes the per-step latency cost of the reference's per-layer
+exchange (mpi_new.cpp:327-352) by k - halo BYTES per layer stay the same,
+messages drop k-fold.
 
-A full 3D mesh with k-fusion would need trapezoidal ghost regions on 6
-faces + edges + corners (the y/z rolls stop being the boundary condition
-once those axes are cut); measured single-chip gains come almost entirely
-from the x-onion, so the x-only restriction keeps the kernel identical to
-the proven one.  For 3D decompositions the 1-step sharded solver
-(solver/sharded.py) remains the general path.
+Two kernel regimes, dispatched on the mesh:
 
-Per-layer L-inf errors: each shard's kernel emits (k, N/P) per-x-plane
-maxes; shard_map concatenates them along x (out_spec P(None, "x")) into
-global (layer, N) rows and the tiny per-plane rescale + interior mask run
-on the replicated result - no pmax collective needed, the rows ARE the
-reduction layout.
+ * **x-only** ((P, 1, 1)): y/z stay full-domain per shard, so the
+   in-kernel y/z rolls and Dirichlet mask are exactly the single-device
+   kernel's; one cyclic x-ppermute pair per field per k-block.
+ * **x/y** ((MX, MY, 1)): each block is first extended with k cyclic
+   ghost ROWS per y side (one y-ppermute pair), then the x ghost planes
+   are ppermute'd FROM THE EXTENDED blocks - the diagonal corner data a
+   2D onion needs arrives through that sequencing with no extra
+   collectives.  The kernel keeps the extended y width constant (rolls
+   still deliver neighbours for every onion-valid row; staleness creeps
+   only through ghost rows that are never written back) and re-imposes
+   the Dirichlet zero on the WRAPPED global y index, so evolved ghost
+   copies of the y=0 stored plane stay zero.  Ops per valid element are
+   identical to the single-device kernel's - results stay bitwise equal
+   across every mesh shape (tests/test_sharded_kfused.py).
+
+z stays unsharded (MZ = 1): z is the 128-lane dimension, and cutting it
+would shrink every vector register tile; BASELINE's target meshes up to
+256 chips factor as (MX, MY, 1) without it.
+
+Per-layer L-inf errors: each shard's kernel emits (k, N/MX) per-x-plane
+maxes over its y range, pmax'd over the y axis and concatenated along x
+(out_spec P(None, "x")) into global (layer, N) rows; the tiny per-plane
+rescale + interior mask run on the replicated result.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -44,17 +53,27 @@ from wavetpu.solver import kfused, leapfrog
 from wavetpu.solver.leapfrog import SolveResult
 
 
-def _validate(problem: Problem, k: int, n_shards: int):
+def _validate(problem: Problem, k: int, n_x: int, n_y: int = 1):
     if k < 2:
         raise ValueError(f"k must be >= 2 (got {k})")
-    if problem.N % n_shards:
+    if problem.N % n_x:
         raise ValueError(
             f"x-sharded k-fusion needs N % shards == 0 "
-            f"(N={problem.N}, shards={n_shards})"
+            f"(N={problem.N}, shards={n_x})"
         )
-    if (problem.N // n_shards) % k:
+    if (problem.N // n_x) % k:
         raise ValueError(
-            f"k={k} must divide the shard depth {problem.N // n_shards}"
+            f"k={k} must divide the shard depth {problem.N // n_x}"
+        )
+    if problem.N % n_y:
+        raise ValueError(
+            f"y-sharded k-fusion needs N % y-shards == 0 "
+            f"(N={problem.N}, y-shards={n_y})"
+        )
+    if problem.N // n_y < k:
+        raise ValueError(
+            f"k={k} exceeds the y shard depth {problem.N // n_y} "
+            f"(the k-row ghost strip must fit one neighbour)"
         )
 
 
@@ -74,7 +93,7 @@ def _assemble_errors(oracle_parts, dmax_rows, rmax_rows):
 def _make_runner(
     problem: Problem,
     mesh,
-    n_shards: int,
+    shard_grid: Tuple[int, int],
     dtype,
     k: int,
     compute_errors: bool,
@@ -85,18 +104,28 @@ def _make_runner(
 ):
     """One jitted program: [bootstrap +] k-block scan + 1-step remainder.
 
+    `shard_grid` = (n_x, n_y) mesh extents.  n_y == 1 runs the x-only
+    kernel (in-shard y rolls ARE the boundary condition); n_y > 1 extends
+    each block with k ghost rows per side via a cyclic y-ppermute pair and
+    runs the xy kernel - the x ghosts are then sliced FROM the extended
+    blocks, which ships the diagonal corners without extra collectives.
+
     `start_step=None` builds the from-scratch solver (bootstrap included);
     an int builds the resume program re-entering at that layer.  Both use
     the same local march so the per-layer op sequence is identical (the
     bitwise-resume invariant, solver/kfused.py).
     """
+    n_x, n_y = shard_grid
     f = stencil_ref.compute_dtype(dtype)
-    nl = problem.N // n_shards
+    nl = problem.N // n_x
+    nl_y = problem.N // n_y
     oracle_parts = kfused._oracle_parts(problem, f)
     sx, ct, syz, rsyz, _, _ = oracle_parts
     sxct_all = ct[:, None] * sx[None, :]            # (T+1, N)
-    perm_fwd = [(i, (i + 1) % n_shards) for i in range(n_shards)]
-    perm_bwd = [(i, (i - 1) % n_shards) for i in range(n_shards)]
+    perm_fwd = [(i, (i + 1) % n_x) for i in range(n_x)]
+    perm_bwd = [(i, (i - 1) % n_x) for i in range(n_x)]
+    perm_fwd_y = [(i, (i + 1) % n_y) for i in range(n_y)]
+    perm_bwd_y = [(i, (i - 1) % n_y) for i in range(n_y)]
     coeff = problem.a2tau2
     start = 1 if start_step is None else start_step
     nblocks = (nsteps - start) // k
@@ -108,23 +137,46 @@ def _make_runner(
         hi = lax.ppermute(a[:depth], "x", perm_bwd)
         return lo, hi
 
-    def kcall(u_prev, u, sxct_k, kk, with_errors, bxo):
-        return stencil_pallas.fused_kstep_sharded(
-            u_prev, u, ghosts(u_prev, kk), ghosts(u, kk), syz, rsyz,
-            sxct_k, k=kk, coeff=coeff, inv_h2=problem.inv_h2,
-            block_x=bxo, interpret=interpret, with_errors=with_errors,
-        )
+    def extend_y(a, depth):
+        """Block extended with `depth` cyclic ghost rows per y side."""
+        lo = lax.ppermute(a[:, -depth:], "y", perm_fwd_y)
+        hi = lax.ppermute(a[:, :depth], "y", perm_bwd_y)
+        return jnp.concatenate([lo, a, hi], axis=1)
 
-    def layer_rows(u, sxct_row):
+    def kcall(syz_c, rsyz_c, u_prev, u, sxct_k, kk, with_errors, bxo):
+        if n_y == 1:
+            return stencil_pallas.fused_kstep_sharded(
+                u_prev, u, ghosts(u_prev, kk), ghosts(u, kk), syz_c,
+                rsyz_c, sxct_k, k=kk, coeff=coeff, inv_h2=problem.inv_h2,
+                block_x=bxo, interpret=interpret, with_errors=with_errors,
+            )
+        pe = extend_y(u_prev, kk)
+        ce = extend_y(u, kk)
+        y0 = lax.axis_index("y") * nl_y
+        up, uc, dm, rm = stencil_pallas.fused_kstep_sharded_xy(
+            pe, ce, ghosts(pe, kk), ghosts(ce, kk), syz_c, rsyz_c,
+            sxct_k, y0, problem.N, k=kk, nl_y=nl_y, coeff=coeff,
+            inv_h2=problem.inv_h2, block_x=bxo, interpret=interpret,
+            with_errors=with_errors,
+        )
+        if with_errors:
+            dm = lax.pmax(dm, "y")
+            rm = lax.pmax(rm, "y")
+        return up, uc, dm, rm
+
+    def layer_rows(syz_c, rsyz_c, u, sxct_row):
         """(1, nl) plane-max rows of a stored layer (jnp path, used for
-        the bootstrap layer only)."""
-        diff = jnp.abs(u.astype(f) - sxct_row[:, None, None] * syz[None])
-        return (
-            jnp.max(diff, axis=(1, 2))[None],
-            jnp.max(diff * rsyz[None], axis=(1, 2))[None],
-        )
+        the bootstrap layer only); max over this shard's y slice, pmax'd
+        across the y mesh axis."""
+        diff = jnp.abs(u.astype(f) - sxct_row[:, None, None] * syz_c[None])
+        d = jnp.max(diff, axis=(1, 2))[None]
+        r = jnp.max(diff * rsyz_c[None], axis=(1, 2))[None]
+        if n_y > 1:
+            d = lax.pmax(d, "y")
+            r = lax.pmax(r, "y")
+        return d, r
 
-    def local_march(u_prev, u, sxct_loc, first):
+    def local_march(syz_c, rsyz_c, u_prev, u, sxct_loc, first):
         """Layers first+1..nsteps; returns carry + (rows_d, rows_r) for
         exactly nsteps - first layers."""
         rows_d, rows_r = [], []
@@ -133,7 +185,8 @@ def _make_runner(
             u_prev, u = carry
             sxct_k = lax.dynamic_slice(sxct_loc, (nstart + 1, 0), (k, nl))
             up, uc, dm, rm = kcall(
-                u_prev, u, sxct_k, k, compute_errors, block_x
+                syz_c, rsyz_c, u_prev, u, sxct_k, k, compute_errors,
+                block_x,
             )
             if not compute_errors:
                 dm = rm = jnp.zeros((k, nl), f)
@@ -147,7 +200,7 @@ def _make_runner(
             layer = nsteps - rem + 1 + t
             sxct_1 = lax.dynamic_slice(sxct_loc, (layer, 0), (1, nl))
             u_prev, u, dm, rm = kcall(
-                u_prev, u, sxct_1, 1, compute_errors, None
+                syz_c, rsyz_c, u_prev, u, sxct_1, 1, compute_errors, None
             )
             if not compute_errors:
                 dm = rm = jnp.zeros((1, nl), f)
@@ -155,23 +208,27 @@ def _make_runner(
             rows_r.append(rm)
         return u_prev, u, jnp.concatenate(rows_d), jnp.concatenate(rows_r)
 
-    state_spec = P("x")
+    state_spec = P("x", "y")
     rows_spec = P(None, "x")
+    plane_spec = P("y", None)
 
     if start_step is None:
 
-        def local(u0, sxct_loc):
+        def local(u0, sxct_loc, syz_c, rsyz_c):
             # kcall returns (layer n+k-1, layer n+k, ...): the stepped
             # field u0 + C*lap(u0) is the SECOND output.
             _, s0, _, _ = kcall(
-                u0, u0, jnp.zeros((1, nl), f), 1, False, None
+                syz_c, rsyz_c, u0, u0, jnp.zeros((1, nl), f), 1, False,
+                None,
             )
             u1 = (0.5 * (u0.astype(f) + s0.astype(f))).astype(dtype)
             if compute_errors:
-                d1, r1 = layer_rows(u1, sxct_loc[1])
+                d1, r1 = layer_rows(syz_c, rsyz_c, u1, sxct_loc[1])
             else:
                 d1 = r1 = jnp.zeros((1, nl), f)
-            u_prev, u, rows_d, rows_r = local_march(u0, u1, sxct_loc, 1)
+            u_prev, u, rows_d, rows_r = local_march(
+                syz_c, rsyz_c, u0, u1, sxct_loc, 1
+            )
             zero = jnp.zeros((1, nl), f)
             return (
                 u_prev, u,
@@ -181,7 +238,7 @@ def _make_runner(
 
         local_fn = jax.shard_map(
             local, mesh=mesh,
-            in_specs=(state_spec, rows_spec),
+            in_specs=(state_spec, rows_spec, plane_spec, plane_spec),
             out_specs=(state_spec, state_spec, rows_spec, rows_spec),
             # vma inference cannot see through the pallas kernel's mixed
             # ghost/wraparound concat (same workaround as solver/timing.py)
@@ -193,7 +250,7 @@ def _make_runner(
                 leapfrog.initial_layer0(problem, dtype),
                 NamedSharding(mesh, state_spec),
             )
-            u_prev, u, dmax, rmax = local_fn(u0, sxct_all)
+            u_prev, u, dmax, rmax = local_fn(u0, sxct_all, syz, rsyz)
             if compute_errors:
                 abs_e, rel_e = _assemble_errors(oracle_parts, dmax, rmax)
             else:
@@ -202,9 +259,9 @@ def _make_runner(
 
         return jax.jit(run), ()
 
-    def local_resume(u_prev, u, sxct_loc):
+    def local_resume(u_prev, u, sxct_loc, syz_c, rsyz_c):
         u_prev, u, rows_d, rows_r = local_march(
-            u_prev, u, sxct_loc, start_step
+            syz_c, rsyz_c, u_prev, u, sxct_loc, start_step
         )
         head = jnp.zeros((start_step + 1, nl), f)
         return (
@@ -215,13 +272,14 @@ def _make_runner(
 
     local_fn = jax.shard_map(
         local_resume, mesh=mesh,
-        in_specs=(state_spec, state_spec, rows_spec),
+        in_specs=(state_spec, state_spec, rows_spec, plane_spec,
+                  plane_spec),
         out_specs=(state_spec, state_spec, rows_spec, rows_spec),
         check_vma=False,
     )
 
     def run(u_prev, u):
-        u_prev, u, dmax, rmax = local_fn(u_prev, u, sxct_all)
+        u_prev, u, dmax, rmax = local_fn(u_prev, u, sxct_all, syz, rsyz)
         if compute_errors:
             abs_e, rel_e = _assemble_errors(oracle_parts, dmax, rmax)
         else:
@@ -229,6 +287,20 @@ def _make_runner(
         return u_prev, u, abs_e, rel_e
 
     return jax.jit(run), None
+
+
+def _resolve_grid(mesh_shape, n_shards, devices):
+    """(n_x, n_y) from an explicit (MX, MY, 1) mesh_shape, the x-only
+    n_shards shorthand, or all visible devices."""
+    if mesh_shape is not None:
+        if len(mesh_shape) != 3 or mesh_shape[2] != 1:
+            raise ValueError(
+                f"k-fusion supports (MX, MY, 1) meshes, got {mesh_shape}"
+            )
+        return mesh_shape[0], mesh_shape[1]
+    if n_shards is None:
+        n_shards = len(devices)
+    return n_shards, 1
 
 
 def solve_sharded_kfused(
@@ -241,24 +313,26 @@ def solve_sharded_kfused(
     block_x: Optional[int] = None,
     interpret: Optional[bool] = None,
     devices: Optional[Sequence[jax.Device]] = None,
+    mesh_shape: Optional[Tuple[int, int, int]] = None,
 ) -> SolveResult:
-    """k-fused solve over an (n_shards, 1, 1) mesh (defaults to all
-    devices); reference timing phases as `leapfrog.solve`."""
+    """k-fused solve over an (MX, MY, 1) mesh; reference timing phases as
+    `leapfrog.solve`.  `n_shards` is the x-only shorthand (MX, 1, 1);
+    `mesh_shape` selects a 2D decomposition (defaults to all devices on
+    the x axis)."""
     if devices is None:
         devices = jax.devices()
-    if n_shards is None:
-        n_shards = len(devices)
+    n_x, n_y = _resolve_grid(mesh_shape, n_shards, devices)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    _validate(problem, k, n_shards)
+    _validate(problem, k, n_x, n_y)
     nsteps = problem.timesteps if stop_step is None else stop_step
     if not 1 <= nsteps <= problem.timesteps:
         raise ValueError(
             f"stop_step must be in [1, {problem.timesteps}], got {nsteps}"
         )
-    mesh = build_mesh((n_shards, 1, 1), devices[:n_shards])
+    mesh = build_mesh((n_x, n_y, 1), devices[: n_x * n_y])
     runner, _ = _make_runner(
-        problem, mesh, n_shards, dtype, k, compute_errors, nsteps,
+        problem, mesh, (n_x, n_y), dtype, k, compute_errors, nsteps,
         None, block_x, interpret,
     )
     (u_prev, u_cur, abs_all, rel_all), init_s, solve_s = (
@@ -291,30 +365,31 @@ def resume_sharded_kfused(
     block_x: Optional[int] = None,
     interpret: Optional[bool] = None,
     devices: Optional[Sequence[jax.Device]] = None,
+    mesh_shape: Optional[Tuple[int, int, int]] = None,
 ) -> SolveResult:
-    """Re-enter the x-sharded k-fused march at layer `start_step`.
+    """Re-enter the sharded k-fused march at layer `start_step`.
 
     `u_prev`/`u_cur` may be global jax.Arrays (a live sharded result) or
-    host arrays (a loaded checkpoint); they are placed P("x") on the mesh.
+    host arrays (a loaded checkpoint); they are placed P("x", "y") on the
+    mesh (see `solve_sharded_kfused` for the mesh parameters).
     """
     if devices is None:
         devices = jax.devices()
-    if n_shards is None:
-        n_shards = len(devices)
+    n_x, n_y = _resolve_grid(mesh_shape, n_shards, devices)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    _validate(problem, k, n_shards)
+    _validate(problem, k, n_x, n_y)
     nsteps = problem.timesteps
     if not 1 <= start_step <= nsteps:
         raise ValueError(
             f"start_step must be in [1, {nsteps}], got {start_step}"
         )
-    mesh = build_mesh((n_shards, 1, 1), devices[:n_shards])
+    mesh = build_mesh((n_x, n_y, 1), devices[: n_x * n_y])
     runner, _ = _make_runner(
-        problem, mesh, n_shards, dtype, k, compute_errors, nsteps,
+        problem, mesh, (n_x, n_y), dtype, k, compute_errors, nsteps,
         start_step, block_x, interpret,
     )
-    sharding = NamedSharding(mesh, P("x"))
+    sharding = NamedSharding(mesh, P("x", "y"))
     args = (
         jax.device_put(jnp.asarray(u_prev, dtype), sharding),
         jax.device_put(jnp.asarray(u_cur, dtype), sharding),
