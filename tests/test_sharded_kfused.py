@@ -1,8 +1,9 @@
-"""x-sharded k-fused solver: parity with the single-device k-fused path.
+"""Sharded k-fused solver: parity with the single-device k-fused path.
 
-The sharded k-step kernel consumes ppermute'd ghost planes where the
+The sharded k-step kernels consume ppermute'd ghosts (x planes; y rows on
+2D meshes, corners via the sequenced y-then-x exchange) where the
 single-device kernel wraps around - identical values through identical op
-order - so the final state must match BITWISE across mesh sizes, and the
+order - so the final state must match BITWISE across mesh shapes, and the
 per-layer error rows must assemble to the same global errors.  Runs on
 the 8-virtual-CPU mesh in interpret mode (tests/conftest.py).
 """
