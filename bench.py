@@ -26,15 +26,21 @@ import sys
 BASELINE_GCELLS = 6.1  # r1 judge measurement, single v5e chip, jnp-roll f32
 
 
-def _run(tag, fn):
-    """Execute one benchmark config; failures are recorded, not fatal."""
+def _run(tag, fn, errors_computed=True):
+    """Execute one benchmark config; failures are recorded, not fatal.
+
+    `errors_computed=False` publishes max_abs_error as None - an all-zero
+    placeholder array must not read as a perfect result (same contract as
+    io/report.py's sidecar)."""
     import traceback
 
     try:
         res = fn()
         return {
             "gcells_per_s": round(res.gcells_per_second, 3),
-            "max_abs_error": float(res.abs_errors.max()),
+            "max_abs_error": (
+                float(res.abs_errors.max()) if errors_computed else None
+            ),
             "solve_seconds": round(res.solve_seconds, 3),
         }
     except Exception:
@@ -93,6 +99,13 @@ def main() -> int:
             lambda: kfused.solve_kfused(
                 problem, k=2, interpret=not on_tpu
             ),
+        ),
+        "kfused_k4_f32_noerrors": _run(
+            "kfused_k4_f32_noerrors",
+            lambda: kfused.solve_kfused(
+                problem, k=4, compute_errors=False, interpret=not on_tpu
+            ),
+            errors_computed=False,
         ),
         "kfused_k4_bf16": _run(
             "kfused_k4_bf16",

@@ -359,3 +359,26 @@ def test_cli_resumed_xy_kfused_phase_timing_rejected_presolve(
         ["--resume", ck, "--fuse-steps", "4", "--phase-timing"]
     ) == 2
     assert "x-only" in capsys.readouterr().err
+
+
+def test_cli_json_run_config(tmp_path, capsys):
+    """The JSON sidecar records how the run was produced (backend, kernel,
+    scheme, fuse_steps, mesh, dtype) - the runtime equivalent of the
+    reference encoding its configuration in which binary ran."""
+    assert cli.main(
+        ["16", "1", "1", "1", "1", "1", "5", "--fuse-steps", "4",
+         "--mesh", "2,2,1", "--dtype", "bf16", "--out-dir", str(tmp_path)]
+    ) == 0
+    capsys.readouterr()
+    side = json.load(open(tmp_path / "output_N16_Np4_TPU.json"))
+    cfg = side["run_config"]
+    assert cfg == {
+        "backend": "sharded",
+        "kernel": "pallas",
+        "scheme": "standard",
+        "fuse_steps": 4,
+        "mesh": [2, 2, 1],
+        "dtype": "bfloat16",
+        "distributed": False,
+        "resumed": False,
+    }

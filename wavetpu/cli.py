@@ -685,6 +685,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             exchange_seconds=exchange_seconds,
             loop_seconds=loop_seconds,
             probe_steps=probe_steps,
+            run_config={
+                "backend": backend,
+                "kernel": kernel,
+                "scheme": scheme,
+                "fuse_steps": fuse_steps,
+                "mesh": list(shape) if backend == "sharded" else None,
+                # The state's actual dtype (a resumed run inherits the
+                # checkpoint's, which may differ from the flag default).
+                "dtype": jnp.dtype(result.u_cur.dtype).name,
+                "distributed": distributed,
+                "resumed": "resume" in flags,
+            },
         )
     say(f"grids initialized in {int(result.init_seconds * 1000)}ms")
     say(

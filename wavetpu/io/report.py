@@ -89,8 +89,15 @@ def write_report(
     json_sidecar: bool = True,
     errors_computed: bool = True,
     probe_steps: Optional[int] = None,
+    run_config: Optional[dict] = None,
 ) -> str:
-    """Write the text report (+ JSON sidecar); returns the text-file path."""
+    """Write the text report (+ JSON sidecar); returns the text-file path.
+
+    `run_config` (JSON-serializable) records how the run was produced -
+    backend, kernel, scheme, fuse_steps, mesh, dtype - so a sidecar is
+    self-describing (the reference encodes this in the BINARY it ran;
+    the runtime-selected equivalent must travel with the output).
+    """
     p = result.problem
     name = report_filename(p.N, n_procs, variant)
     os.makedirs(out_dir, exist_ok=True)
@@ -125,6 +132,7 @@ def write_report(
             "exchange_seconds": exchange_seconds,
             "loop_seconds": loop_seconds,
             "phase_probe_steps": probe_steps,
+            "run_config": run_config,
         }
         # Derive the sidecar from `name` (not `path`): out_dir may itself
         # contain ".txt".
