@@ -8,6 +8,8 @@ intermediate layers that never reach HBM.  Interpret mode on the CPU
 backend (tests/conftest.py); on-chip throughput is bench.py's job.
 """
 
+import functools
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -17,7 +19,11 @@ from wavetpu.kernels import stencil_pallas
 from wavetpu.solver import kfused, leapfrog
 
 
+@functools.lru_cache(maxsize=None)
 def _pallas_solve(problem, dtype=jnp.float32, **kw):
+    """Memoized 1-step pallas reference solve (Problem is frozen, hence a
+    valid cache key): the parity matrix reuses the same configs, each
+    paying an interpret-mode compile."""
     return leapfrog.solve(
         problem, dtype=dtype,
         step_fn=stencil_pallas.make_step_fn(interpret=True), **kw

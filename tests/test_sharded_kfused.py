@@ -8,12 +8,24 @@ per-layer error rows must assemble to the same global errors.  Runs on
 the 8-virtual-CPU mesh in interpret mode (tests/conftest.py).
 """
 
+import functools
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from wavetpu.core.problem import Problem
 from wavetpu.solver import kfused, sharded_kfused
+
+
+@functools.lru_cache(maxsize=None)
+def _single(problem, k, dtype=jnp.float32, errors=True):
+    """Memoized single-device k-fused reference solve (Problem is frozen,
+    hence a valid cache key): several parity cases share a config, and
+    each solve pays an interpret-mode compile."""
+    return kfused.solve_kfused(
+        problem, dtype=dtype, k=k, compute_errors=errors, interpret=True
+    )
 
 
 @pytest.mark.parametrize("n_shards,k,timesteps", [
@@ -26,7 +38,7 @@ from wavetpu.solver import kfused, sharded_kfused
 ])
 def test_state_matches_single_device_kfused(n_shards, k, timesteps):
     p = Problem(N=16, timesteps=timesteps)
-    want = kfused.solve_kfused(p, k=k, interpret=True)
+    want = _single(p, k)
     got = sharded_kfused.solve_sharded_kfused(
         p, n_shards=n_shards, k=k, interpret=True
     )
@@ -41,7 +53,7 @@ def test_state_matches_single_device_kfused(n_shards, k, timesteps):
 @pytest.mark.parametrize("n_shards,k", [(2, 2), (4, 4)])
 def test_errors_match_single_device_kfused(n_shards, k):
     p = Problem(N=16, timesteps=11)
-    want = kfused.solve_kfused(p, k=k, interpret=True)
+    want = _single(p, k)
     got = sharded_kfused.solve_sharded_kfused(
         p, n_shards=n_shards, k=k, interpret=True
     )
@@ -104,7 +116,7 @@ def test_no_errors_mode():
         p, n_shards=2, k=4, compute_errors=False, interpret=True
     )
     assert (got.abs_errors == 0).all()
-    want = kfused.solve_kfused(p, k=4, compute_errors=False, interpret=True)
+    want = _single(p, 4, errors=False)
     np.testing.assert_array_equal(
         np.asarray(got.u_cur), np.asarray(want.u_cur)
     )
@@ -112,7 +124,7 @@ def test_no_errors_mode():
 
 def test_bf16_state():
     p = Problem(N=16, timesteps=9)
-    want = kfused.solve_kfused(p, dtype=jnp.bfloat16, k=4, interpret=True)
+    want = _single(p, 4, jnp.bfloat16)
     got = sharded_kfused.solve_sharded_kfused(
         p, n_shards=2, dtype=jnp.bfloat16, k=4, interpret=True
     )
@@ -149,7 +161,7 @@ def test_xy_mesh_matches_single_device(mesh, k, timesteps):
     corner data via sequenced exchange) is bitwise equal to the
     single-device k-fused solve."""
     p = Problem(N=16, timesteps=timesteps)
-    want = kfused.solve_kfused(p, k=k, interpret=True)
+    want = _single(p, k)
     got = sharded_kfused.solve_sharded_kfused(
         p, mesh_shape=mesh, k=k, interpret=True
     )
@@ -184,7 +196,7 @@ def test_xy_mesh_stop_resume_bitwise():
 
 def test_xy_mesh_bf16():
     p = Problem(N=16, timesteps=9)
-    want = kfused.solve_kfused(p, dtype=jnp.bfloat16, k=4, interpret=True)
+    want = _single(p, 4, jnp.bfloat16)
     got = sharded_kfused.solve_sharded_kfused(
         p, mesh_shape=(2, 2, 1), dtype=jnp.bfloat16, k=4, interpret=True
     )
