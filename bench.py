@@ -63,13 +63,16 @@ def main() -> int:
     problem = Problem(N=n, timesteps=steps)
     on_tpu = jax.default_backend() == "tpu"
     backend = "pallas k=4 fused"
+    headline_runs = []
     try:
         res = kfused.solve_kfused(problem, k=4)  # f32, per-layer errors on
+        headline_runs.append(round(res.solve_seconds, 3))
         try:
             # Headline = best of two runs: the shared-tunnel chip shows
             # ~+-15% run-to-run solve-time variance; one extra run bounds
             # the noise.  A transient failure here must not discard run 1.
             res2 = kfused.solve_kfused(problem, k=4)
+            headline_runs.append(round(res2.solve_seconds, 3))
             if res2.solve_seconds < res.solve_seconds:
                 res = res2
         except Exception:
@@ -85,6 +88,7 @@ def main() -> int:
         traceback.print_exc()
         backend = "jnp-roll"
         res = leapfrog.solve(problem)
+        headline_runs.append(round(res.solve_seconds, 3))
 
     subs = {
         "pallas_1step_f32": _run(
@@ -160,6 +164,11 @@ def main() -> int:
             "backend": f"single-chip {backend}",
         },
         "solve_seconds": round(res.solve_seconds, 3),
+        # The headline alone is best-of-N (sub-benchmarks are single-run);
+        # record the policy and every run so the artifact is self-describing
+        # and headline-vs-sub comparisons are not unlike quantities.
+        "headline_policy": f"best_of_{max(len(headline_runs), 1)}",
+        "headline_run_seconds": headline_runs,
         "compile_seconds": round(res.init_seconds, 3),
         "max_abs_error": float(res.abs_errors.max()),
         "sub_benchmarks": subs,

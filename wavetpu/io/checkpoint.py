@@ -314,15 +314,31 @@ def load_sharded_checkpoint(path_dir: str, devices=None):
         if os.path.exists(wts_path):
             fields, shard_meta = nativeio.read_container(wts_path)
             if shard_meta.get("step") != step:
-                raise ValueError(
-                    f"shard {_shard_filename(starts)} holds step "
-                    f"{shard_meta.get('step')} but meta says {step}: "
-                    f"checkpoint was interrupted mid-save; discard it"
+                # A WTS1 save overwriting a legacy .npz checkpoint was
+                # preempted mid-way: the stale meta still describes the
+                # legacy files.  Fall back to the legacy shard when its
+                # step matches meta - that checkpoint is fully intact.
+                legacy_path = os.path.join(
+                    path_dir, _legacy_shard_filename(starts)
                 )
-            for key, bufs in buffers.items():
-                arr, dt = fields[key]
-                bufs.append(jax.device_put(_decode_field(arr, dt), dev))
-            continue
+                # The legacy block below is the single authoritative step
+                # check for .npz shards; here only decide whether one
+                # exists to fall through to.
+                if not os.path.exists(legacy_path):
+                    raise ValueError(
+                        f"shard {_shard_filename(starts)} holds step "
+                        f"{shard_meta.get('step')} but meta says {step}: "
+                        f"checkpoint was interrupted mid-save; discard it "
+                        f"(if this directory held an older .npz checkpoint, "
+                        f"its shards may still be intact and recoverable)"
+                    )
+            else:
+                for key, bufs in buffers.items():
+                    arr, dt = fields[key]
+                    bufs.append(
+                        jax.device_put(_decode_field(arr, dt), dev)
+                    )
+                continue
         # Legacy .npz shard layout (pre-WTS1 checkpoints).  A checkpoint
         # with NEITHER file is reported against the current format's name,
         # not the legacy one.

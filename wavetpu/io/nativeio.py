@@ -159,12 +159,18 @@ class AsyncFileWriter:
             self._file.write(mv)
             self._crc = zlib.crc32(mv, self._crc) & 0xFFFFFFFF
 
-    def finish(self) -> int:
-        """Drain, fsync, atomically rename; returns the stream CRC32."""
+    def sync(self) -> int:
+        """Drain and fsync the TEMP file (no rename); returns the stream
+        CRC32.  The temp file stays on disk until `commit` renames it -
+        callers that cross-check the CRC (finish_container) do so between
+        the two phases, so a detected corruption can discard the temp file
+        without having replaced the previous good file at `final_path`."""
         if self._lib is not None:
             crc = ctypes.c_uint64(0)
+            # Renaming the temp file onto itself is a POSIX no-op, so the
+            # native finish becomes drain+fsync+close with the temp kept.
             rc = self._lib.ckpt_writer_finish(
-                self._handle, self.final_path.encode(), ctypes.byref(crc)
+                self._handle, self.tmp_path.encode(), ctypes.byref(crc)
             )
             self._handle = None
             self._bufs.clear()
@@ -178,9 +184,8 @@ class AsyncFileWriter:
             self._file.flush()
             os.fsync(self._file.fileno())
             self._file.close()
-            os.replace(self.tmp_path, self.final_path)
         except Exception:
-            # Mirror the native path: never leave the temp file behind.
+            # Never leave the temp file behind.
             if not self._file.closed:
                 self._file.close()
             if os.path.exists(self.tmp_path):
@@ -188,6 +193,29 @@ class AsyncFileWriter:
             raise
         self._bufs.clear()
         return self._crc
+
+    def commit(self) -> None:
+        """Atomically rename the synced temp file to `final_path`."""
+        try:
+            os.replace(self.tmp_path, self.final_path)
+        except Exception:
+            # Keep the never-leave-a-temp-behind invariant on rename
+            # failure (abort() is a no-op once sync() has closed the file).
+            self.discard()
+            raise
+
+    def discard(self) -> None:
+        """Remove the synced temp file (CRC cross-check failed)."""
+        try:
+            os.remove(self.tmp_path)
+        except OSError:
+            pass
+
+    def finish(self) -> int:
+        """Drain, fsync, atomically rename; returns the stream CRC32."""
+        crc = self.sync()
+        self.commit()
+        return crc
 
     def abort(self) -> None:
         if self._lib is not None and self._handle is not None:
@@ -249,24 +277,22 @@ def write_container(
 
 def finish_container(w: "AsyncFileWriter") -> int:
     """Complete a `write_container` writer, verifying the stream CRC the
-    writer thread computed against the host-side one.
+    writer thread computed against the host-side one BEFORE the rename.
 
-    On a mismatch the just-renamed file is unlinked before raising - a
-    corrupt container must never sit at the final name (where it would
-    have replaced the previous good shard)."""
-    stream_crc = w.finish()
+    On a mismatch the temp file is discarded and the previous good file at
+    the final name (if any) is left intact - a corrupt container never
+    replaces a good shard."""
+    stream_crc = w.sync()
     expected = crc32(
         struct.pack("<I", w._expected_crc) + _FOOTER_MAGIC, w._expected_crc
     )
     if stream_crc != expected:
-        try:
-            os.remove(w.final_path)
-        except OSError:
-            pass
+        w.discard()
         raise IOError(
             f"checkpoint writer CRC mismatch on {w.final_path}: a buffer "
             f"was modified during the asynchronous write"
         )
+    w.commit()
     return w._expected_crc
 
 
@@ -297,18 +323,49 @@ def read_container(path: str, verify: bool = True):
             )
     hlen = struct.unpack("<I", blob[len(_MAGIC):len(_MAGIC) + 4])[0]
     hstart = len(_MAGIC) + 4
-    header = json.loads(blob[hstart:hstart + hlen].decode())
+    payload_end = len(blob) - 12  # footer: u32 CRC + 8-byte magic
+    # Structural bounds checks run even with verify=False (the documented
+    # forensic mode): a malformed file must surface as this module's own
+    # errors, not a raw json/numpy exception downstream.
+    if hstart + hlen > payload_end:
+        raise ValueError(
+            f"{path}: truncated checkpoint (header length {hlen} exceeds "
+            f"file payload)"
+        )
+    try:
+        header = json.loads(blob[hstart:hstart + hlen].decode())
+        entries = header["arrays"]
+        meta = header["meta"]
+        # Schema-check every field the loop below will access, so a
+        # corrupt-but-parseable header also surfaces as this module's error.
+        total = sum(int(e["nbytes"]) for e in entries)
+        for e in entries:
+            e["name"], str(e["dtype"]), list(e["shape"])
+    except (ValueError, KeyError, TypeError, UnicodeDecodeError) as e:
+        raise ValueError(
+            f"{path}: corrupt checkpoint header ({e})"
+        ) from None
+    if hstart + hlen + total != payload_end:
+        raise ValueError(
+            f"{path}: truncated checkpoint (arrays declare {total} payload "
+            f"bytes, file carries {payload_end - hstart - hlen})"
+        )
     out = {}
     off = hstart + hlen
-    for e in header["arrays"]:
-        nbytes = e["nbytes"]
+    for e in entries:
+        nbytes = int(e["nbytes"])
         dtype = (
             np.dtype(np.uint16) if e["dtype"] == "bfloat16"
             else np.dtype(e["dtype"])
         )
-        arr = np.frombuffer(
-            blob, dtype=dtype, count=nbytes // dtype.itemsize, offset=off
-        ).reshape(e["shape"])
+        try:
+            arr = np.frombuffer(
+                blob, dtype=dtype, count=nbytes // dtype.itemsize, offset=off
+            ).reshape(e["shape"])
+        except ValueError as err:
+            raise ValueError(
+                f"{path}: corrupt checkpoint array {e.get('name')!r} ({err})"
+            ) from None
         off += nbytes
         out[e["name"]] = (arr, e["dtype"])
-    return out, header["meta"]
+    return out, meta

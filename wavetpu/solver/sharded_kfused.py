@@ -56,6 +56,10 @@ from wavetpu.solver.leapfrog import SolveResult
 def _validate(problem: Problem, k: int, n_x: int, n_y: int = 1):
     if k < 2:
         raise ValueError(f"k must be >= 2 (got {k})")
+    if n_x < 1 or n_y < 1:
+        raise ValueError(
+            f"mesh axes must be >= 1 (got MX={n_x}, MY={n_y})"
+        )
     if problem.N % n_x:
         raise ValueError(
             f"x-sharded k-fusion needs N % shards == 0 "
