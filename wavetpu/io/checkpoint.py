@@ -143,6 +143,21 @@ def _legacy_shard_filename(starts) -> str:
     return f"shard_{starts[0]}_{starts[1]}_{starts[2]}.npz"
 
 
+def _legacy_shard_has_step(legacy_path: str, step: int) -> bool:
+    """True iff a legacy .npz shard exists AND explicitly records `step`.
+
+    Used to gate the WTS-mismatch fallback: a step-less legacy shard
+    (the ancient layout) is loadable as a whole-directory legacy
+    checkpoint but must never be mixed into a partially written WTS one.
+    """
+    import os
+
+    if not os.path.exists(legacy_path):
+        return False
+    with np.load(legacy_path) as z:
+        return "step" in z.files and int(z["step"]) == step
+
+
 def save_sharded_checkpoint(path_dir: str, result: SolveResult) -> str:
     """Write a sharded solve's state as one file per shard plus a meta file.
 
@@ -311,20 +326,20 @@ def load_sharded_checkpoint(path_dir: str, devices=None):
     for dev, idx in imap.items():
         starts = tuple(int(sl.start or 0) for sl in idx)
         wts_path = os.path.join(path_dir, _shard_filename(starts))
+        legacy_path = os.path.join(
+            path_dir, _legacy_shard_filename(starts)
+        )
         if os.path.exists(wts_path):
             fields, shard_meta = nativeio.read_container(wts_path)
             if shard_meta.get("step") != step:
                 # A WTS1 save overwriting a legacy .npz checkpoint was
                 # preempted mid-way: the stale meta still describes the
-                # legacy files.  Fall back to the legacy shard when its
-                # step matches meta - that checkpoint is fully intact.
-                legacy_path = os.path.join(
-                    path_dir, _legacy_shard_filename(starts)
-                )
-                # The legacy block below is the single authoritative step
-                # check for .npz shards; here only decide whether one
-                # exists to fall through to.
-                if not os.path.exists(legacy_path):
+                # legacy files.  Fall back to the legacy shard ONLY when
+                # it explicitly carries the step meta describes - a
+                # step-less (ancient) .npz here could predate meta
+                # entirely and must not be assembled into a mixed-step
+                # state.
+                if not _legacy_shard_has_step(legacy_path, step):
                     raise ValueError(
                         f"shard {_shard_filename(starts)} holds step "
                         f"{shard_meta.get('step')} but meta says {step}: "
@@ -342,9 +357,6 @@ def load_sharded_checkpoint(path_dir: str, devices=None):
         # Legacy .npz shard layout (pre-WTS1 checkpoints).  A checkpoint
         # with NEITHER file is reported against the current format's name,
         # not the legacy one.
-        legacy_path = os.path.join(
-            path_dir, _legacy_shard_filename(starts)
-        )
         if not os.path.exists(legacy_path):
             raise FileNotFoundError(
                 f"checkpoint shard missing: {wts_path}"

@@ -340,7 +340,9 @@ def read_container(path: str, verify: bool = True):
         # corrupt-but-parseable header also surfaces as this module's error.
         total = sum(int(e["nbytes"]) for e in entries)
         for e in entries:
-            e["name"], str(e["dtype"]), list(e["shape"])
+            e["name"], list(e["shape"])
+            if e["dtype"] != "bfloat16":
+                np.dtype(e["dtype"])  # TypeError here, not in the loop
     except (ValueError, KeyError, TypeError, UnicodeDecodeError) as e:
         raise ValueError(
             f"{path}: corrupt checkpoint header ({e})"

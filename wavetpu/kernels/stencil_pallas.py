@@ -644,6 +644,11 @@ def make_compensated_step_fn(block_x=None, interpret=False):
 
 _KSTEP_VMEM_LIMIT = 127 * 1024 * 1024
 _KSTEP_VMEM_BUDGET = 122 * 1024 * 1024
+# The comp (velocity-form) onion at N=512 k=4 bx=4 f32 needs 127.72 MB -
+# 728 KB over the standard onion ceiling but still inside the v5e's
+# 128 MiB physical VMEM; Mosaic accepts it with the ceiling at 127.9 MB
+# (measured on chip; 33.1 Gcell/s, no spill cliff).
+_KSTEP_COMP_VMEM_LIMIT = int(127.9 * 1024 * 1024)
 
 
 def choose_kstep_block(
@@ -812,6 +817,225 @@ def fused_kstep(u_prev, u, syz, rsyz, sxct, *, k, coeff, inv_h2,
     if with_errors:
         return out
     return out[0], out[1], None, None
+
+
+def choose_kstep_comp_block(
+    n: int, k: int, u_itemsize: int = 4, v_itemsize: int = 4,
+    carry_itemsize: Optional[int] = 4, depth: Optional[int] = None,
+    ghosts: bool = False, plane_elems: Optional[int] = None,
+) -> Optional[int]:
+    """Slab depth for the compensated/velocity-form k-step kernel.
+
+    Same shape as `choose_kstep_block` with the comp kernel's working set:
+    u and v onions ride with k-plane halos (each at its own storage
+    itemsize), the carry (when present) slab-only in and out, and the body
+    holds ~3.2 onion-sized f32 temporaries regardless of carry (Mosaic
+    recycles the U/V/C/lap/Kahan buffers down to that; calibrated on v5e
+    against two measured programs: all-f32 carry k=4 bx=4 N=512 actual
+    127.72 MB, and carry-less f32+bf16 k=4 bx=8 actual 134.91 MB - the
+    latter is why bx=8 must be rejected there).  The carry-less
+    coefficient carries an extra safety margin (3.4) because its
+    rejection boundary was measured, not its acceptance.
+    """
+    if depth is None:
+        depth = n
+    if plane_elems is None:
+        plane_elems = n * n
+    pb_f32 = plane_elems * 4
+    state = u_itemsize + v_itemsize
+    has_carry = carry_itemsize is not None
+    best = None
+    bx = k
+    while bx <= 8 and bx <= depth:
+        if depth % bx == 0:
+            onion = bx + 2 * k
+            pipeline = 2 * (onion + bx) * state * plane_elems
+            if has_carry:
+                pipeline += 2 * 2 * bx * carry_itemsize * plane_elems
+            if ghosts:
+                pipeline += 4 * k * state * plane_elems
+            planes = 4 * pb_f32
+            temps = (315 if has_carry else 340) * onion * pb_f32 // 100
+            if pipeline + planes + temps <= _KSTEP_COMP_VMEM_LIMIT:
+                best = bx
+        bx *= 2
+    return best
+
+
+def _kstep_comp_kernel(*refs, k, bx, coeff, inv_h2, compute_dtype,
+                       with_errors, has_carry):
+    """March k compensated (velocity-form) leapfrog substeps on a VMEM
+    slab onion.
+
+    Each substep is the Kahan two-sum update of `_comp_step_kernel`
+    (semantics: stencil_ref.compensated_step): the increment
+    v' = v + C*lap(u) accumulates in its own small-magnitude onion and
+    u' = u + v' runs through the carry.  u and v march as shrinking
+    onions exactly like `_kstep_kernel`; the carry rides slab-only with
+    its halo planes seeded to ZERO - the halo-cone planes are discarded
+    after the block, and their missing compensation re-enters the kept
+    central planes only through coeff*lap of an ~ulp-sized smooth field
+    (measured: no observable error delta vs the 1-step compensated path
+    at N=512/1000 on v5e, both ~5.7e-6).  That approximation is the whole
+    reason this fits VMEM where a 3-field full-onion Kahan scheme does
+    not (solver/kfused.py's round-4 dead-end note).
+
+    `has_carry=False` drops the carry entirely (plain increment form):
+    the mode for a bf16 increment stream, where bf16 quantization of v
+    dwarfs what a carry would recover.
+
+    No bitwise parity with the 1-step path is claimed (unlike
+    `_kstep_kernel`): intermediate layers skip the storage-dtype
+    round-trip and halo carries differ - the contract is tolerance parity
+    vs f64 (tests/test_kfused_comp.py).
+    """
+    it = iter(refs)
+    sxct_ref = next(it)
+    u_ref, ulo_ref, uhi_ref = next(it), next(it), next(it)
+    v_ref, vlo_ref, vhi_ref = next(it), next(it), next(it)
+    carry_ref = next(it) if has_carry else None
+    syz_ref, rsyz_ref = next(it), next(it)
+    out = list(it)
+    u_out, v_out = out[0], out[1]
+    carry_out = out[2] if has_carry else None
+    if with_errors:
+        dmax_ref, rmax_ref = out[-2], out[-1]
+
+    i = pl.program_id(0)
+    f = compute_dtype
+    ix, iy, iz = (jnp.asarray(val, f) for val in inv_h2)
+    U = jnp.concatenate(
+        [ulo_ref[:].astype(f), u_ref[:].astype(f), uhi_ref[:].astype(f)], 0)
+    V = jnp.concatenate(
+        [vlo_ref[:].astype(f), v_ref[:].astype(f), vhi_ref[:].astype(f)], 0)
+    ny, nz = U.shape[1], U.shape[2]
+    if has_carry:
+        zpad = jnp.zeros((k, ny, nz), f)
+        C = jnp.concatenate([zpad, carry_ref[:].astype(f), zpad], 0)
+
+    ym = lax.broadcasted_iota(jnp.int32, (1, ny, nz), 1) != 0
+    zm = lax.broadcasted_iota(jnp.int32, (1, ny, nz), 2) != 0
+    mask = ym & zm
+
+    syz = syz_ref[:]
+    rsyz = rsyz_ref[:]
+
+    for s in range(1, k + 1):
+        uc = U[1:-1]
+        lap = (U[:-2] + U[2:] - 2.0 * uc) * ix
+        lap = lap + (
+            pltpu.roll(uc, 1, 1) + pltpu.roll(uc, ny - 1, 1) - 2.0 * uc
+        ) * iy
+        lap = lap + (
+            pltpu.roll(uc, 1, 2) + pltpu.roll(uc, nz - 1, 2) - 2.0 * uc
+        ) * iz
+        d = jnp.where(mask, jnp.asarray(coeff, f) * lap,
+                      jnp.asarray(0.0, f))
+        vn = V[1:-1] + d
+        if has_carry:
+            y = vn - C[1:-1]
+        else:
+            y = vn
+        t = uc + y
+        if has_carry:
+            C = (t - uc) - y
+        if with_errors:
+            ctr = t[k - s: k - s + bx]
+            for j in range(bx):
+                diff = jnp.abs(ctr[j] - sxct_ref[s - 1, i * bx + j] * syz)
+                # Error rows are f32 diagnostics regardless of the state
+                # dtype (an f64 run's ~1e-13 errors round at 1e-7 relative).
+                dmax_ref[s - 1, i * bx + j] = jnp.max(diff).astype(
+                    jnp.float32)
+                rmax_ref[s - 1, i * bx + j] = jnp.max(diff * rsyz).astype(
+                    jnp.float32)
+        U, V = t, vn
+
+    u_out[:] = U.astype(u_out.dtype)
+    v_out[:] = V.astype(v_out.dtype)
+    if has_carry:
+        carry_out[:] = C.astype(carry_out.dtype)
+
+
+def fused_kstep_comp(u, v, carry, syz, rsyz, sxct, *, k, coeff, inv_h2,
+                     block_x=None, interpret=False, with_errors=True,
+                     compute_dtype=None):
+    """k temporally fused compensated (velocity-form) leapfrog steps.
+
+    State is `(u_n, v_n = u_n - u_{n-1}, carry_n)` as in
+    `stencil_ref.compensated_step`; `carry=None` runs the carry-less
+    increment form (e.g. bf16 v with f32 u).  Each field keeps its own
+    storage dtype; compute is f32.  Returns `(u_{n+k}, v_{n+k},
+    carry_{n+k} | None, dmax, rmax)` with the same (k, N) per-substep
+    per-x-plane error rows as `fused_kstep`.  Requires N % k == 0.
+    """
+    n = u.shape[0]
+    if compute_dtype is None:
+        compute_dtype = stencil_ref.compute_dtype(u.dtype)
+    if n % k:
+        raise ValueError(f"k={k} must divide N={n}")
+    has_carry = carry is not None
+    bx = block_x or choose_kstep_comp_block(
+        n, k, u.dtype.itemsize, v.dtype.itemsize,
+        carry.dtype.itemsize if has_carry else None,
+    )
+    if bx is None:
+        raise ValueError(
+            f"k={k} does not fit VMEM at N={n} (choose_kstep_comp_block)"
+        )
+    if n % bx or bx % k:
+        raise ValueError(f"block_x={bx} must divide N={n} and be a "
+                         f"multiple of k={k}")
+    slab = pl.BlockSpec((bx, n, n), lambda i: (i, 0, 0),
+                        memory_space=pltpu.VMEM)
+    nb = n // k
+    lo = pl.BlockSpec((k, n, n),
+                      lambda i, _bk=bx // k, _nb=nb:
+                      ((i * _bk - 1) % _nb, 0, 0),
+                      memory_space=pltpu.VMEM)
+    hi = pl.BlockSpec((k, n, n),
+                      lambda i, _bk=bx // k, _nb=nb:
+                      (((i + 1) * _bk) % _nb, 0, 0),
+                      memory_space=pltpu.VMEM)
+    plane = pl.BlockSpec((n, n), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    kern = functools.partial(
+        _kstep_comp_kernel, k=k, bx=bx, coeff=coeff, inv_h2=inv_h2,
+        compute_dtype=compute_dtype, with_errors=with_errors,
+        has_carry=has_carry,
+    )
+    in_specs = [smem, slab, lo, hi, slab, lo, hi]
+    operands = [sxct, u, u, u, v, v, v]
+    if has_carry:
+        in_specs.append(slab)
+        operands.append(carry)
+    in_specs += [plane, plane]
+    operands += [syz, rsyz]
+    out_specs = [slab, slab]
+    out_shape = [jax.ShapeDtypeStruct(u.shape, u.dtype),
+                 jax.ShapeDtypeStruct(v.shape, v.dtype)]
+    if has_carry:
+        out_specs.append(slab)
+        out_shape.append(jax.ShapeDtypeStruct(carry.shape, carry.dtype))
+    if with_errors:
+        out_specs += [smem, smem]
+        out_shape += [jax.ShapeDtypeStruct((k, n), jnp.float32)] * 2
+    out = pl.pallas_call(
+        kern,
+        grid=(n // bx,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_KSTEP_COMP_VMEM_LIMIT
+        ),
+        interpret=interpret,
+    )(*operands)
+    u_o, v_o = out[0], out[1]
+    c_o = out[2] if has_carry else None
+    if with_errors:
+        return u_o, v_o, c_o, out[-2], out[-1]
+    return u_o, v_o, c_o, None, None
 
 
 def _kstep_sharded_kernel(sxct_ref, uprev_ref, uc_ref, plo_ref, phi_ref,
