@@ -171,12 +171,91 @@ def test_cli_fuse_steps_validation(capsys):
     base = ["16", "1", "1", "1", "1", "1", "5"]
     assert cli.main(base + ["--fuse-steps", "4", "--kernel", "roll"]) == 2
     assert cli.main(base + ["--fuse-steps", "4", "--mesh", "2,2,2"]) == 2
-    assert cli.main(
-        base + ["--fuse-steps", "4", "--scheme", "compensated"]
-    ) == 2
+    # Compensated k-fusion requires k | N (the velocity-form onion has no
+    # pad-and-mask variant); the standard scheme pads instead.
+    assert cli.main(["18", "1", "1", "1", "1", "1", "5", "--fuse-steps",
+                     "4", "--scheme", "compensated"]) == 2
+    # Uneven layouts that would leave the last shard empty are refused.
+    assert cli.main(base + ["--fuse-steps", "4", "--mesh", "8,1,1"]) == 2
+    # 2D meshes keep the divisibility requirement.
     assert cli.main(["18", "1", "1", "1", "1", "1", "5",
-                     "--fuse-steps", "4"]) == 2  # 4 does not divide 18
+                     "--fuse-steps", "4", "--mesh", "2,3,1"]) == 2
+    # --v-dtype bf16 outside the compensated k-fused mode is an error.
+    assert cli.main(base + ["--v-dtype", "bf16"]) == 2
+    assert cli.main(
+        base + ["--fuse-steps", "4", "--v-dtype", "bf16"]
+    ) == 2
     capsys.readouterr()
+
+
+def test_cli_fuse_steps_uneven(tmp_path, capsys):
+    """k not dividing N routes through the pad-and-mask path and matches
+    the 1-step run's layers (which k-fused paths are bitwise-pinned to)."""
+    base = ["15", "1", "1", "1", "1", "1", "6"]
+    one_dir = str(tmp_path / "one")
+    k_dir = str(tmp_path / "kf")
+    assert cli.main(
+        base + ["--backend", "single", "--out-dir", one_dir]
+    ) == 0
+    assert cli.main(
+        base + ["--fuse-steps", "2", "--out-dir", k_dir]
+    ) == 0
+    capsys.readouterr()
+    one = json.load(open(os.path.join(one_dir, "output_N15_Np1_TPU.json")))
+    kf = json.load(open(os.path.join(k_dir, "output_N15_Np1_TPU.json")))
+    # In-kernel plane-max rows vs the post-hoc jnp oracle differ only in
+    # f32 multiply order (~2e-7 absolute on ~1e-3 errors at N=15).
+    assert kf["abs_errors"] == pytest.approx(one["abs_errors"], rel=1e-4)
+
+
+def test_cli_compensated_kfused(tmp_path, capsys):
+    """--scheme compensated --fuse-steps K (the flagship config) runs and
+    reports, and the bf16 increment mode runs via --v-dtype bf16."""
+    base = ["16", "1", "1", "1", "1", "1", "9"]
+    assert cli.main(
+        base + ["--scheme", "compensated", "--fuse-steps", "4",
+                "--out-dir", str(tmp_path)]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "scheme: compensated" in out and "fuse-steps: 4" in out
+    side = json.load(open(tmp_path / "output_N16_Np1_TPU.json"))
+    assert side["run_config"]["scheme"] == "compensated"
+    assert side["run_config"]["fuse_steps"] == 4
+    assert cli.main(
+        base + ["--scheme", "compensated", "--fuse-steps", "4",
+                "--v-dtype", "bf16", "--out-dir", str(tmp_path)]
+    ) == 0
+    capsys.readouterr()
+    side = json.load(open(tmp_path / "output_N16_Np1_TPU.json"))
+    assert side["run_config"]["v_dtype"] == "bf16"
+
+
+def test_cli_compensated_kfused_resume(tmp_path, capsys):
+    """A compensated checkpoint resumes onto the k-fused path; stopping on
+    a block-aligned layer keeps the remaining march's op sequence equal,
+    so the final error matches the uninterrupted run's."""
+    base = ["16", "1", "1", "1", "1", "1", "9"]
+    full_dir = str(tmp_path / "full")
+    assert cli.main(
+        base + ["--scheme", "compensated", "--fuse-steps", "4",
+                "--out-dir", full_dir]
+    ) == 0
+    ck = str(tmp_path / "comp.npz")
+    assert cli.main(
+        base + ["--scheme", "compensated", "--fuse-steps", "4",
+                "--stop-step", "5", "--save-state", ck,
+                "--out-dir", str(tmp_path)]
+    ) == 0
+    res_dir = str(tmp_path / "res")
+    assert cli.main(
+        ["--resume", ck, "--fuse-steps", "4", "--out-dir", res_dir]
+    ) == 0
+    capsys.readouterr()
+    full = json.load(open(os.path.join(full_dir, "output_N16_Np1_TPU.json")))
+    res = json.load(open(os.path.join(res_dir, "output_N16_Np1_TPU.json")))
+    assert res["abs_errors"][-1] == pytest.approx(
+        full["abs_errors"][-1], rel=1e-6
+    )
 
 
 def test_cli_fuse_steps_phase_timing(tmp_path, capsys):
@@ -195,9 +274,9 @@ def test_cli_fuse_steps_phase_timing(tmp_path, capsys):
 
 def test_cli_fuse_steps_resume_guards(tmp_path, capsys):
     """--fuse-steps must not silently bypass resume semantics: a sharded
-    checkpoint on a non-x-only mesh is rejected, and a compensated
-    checkpoint (whose scheme is inherited AFTER flag validation) is
-    rejected too."""
+    checkpoint on a non-x-only mesh is rejected.  (A single-device
+    compensated checkpoint + --fuse-steps is now the flagship resume
+    path, test_cli_compensated_kfused_resume.)"""
     base = ["16", "1", "1", "1", "1", "1", "8"]
     shard_ck = str(tmp_path / "shard_ck")
     assert cli.main(
@@ -205,15 +284,8 @@ def test_cli_fuse_steps_resume_guards(tmp_path, capsys):
                 "--save-state", shard_ck, "--out-dir", str(tmp_path)]
     ) == 0
     assert cli.main(["--resume", shard_ck, "--fuse-steps", "4"]) == 2
-    comp_ck = str(tmp_path / "comp.npz")
-    assert cli.main(
-        base + ["--backend", "single", "--scheme", "compensated",
-                "--stop-step", "3", "--save-state", comp_ck,
-                "--out-dir", str(tmp_path)]
-    ) == 0
-    assert cli.main(["--resume", comp_ck, "--fuse-steps", "4"]) == 2
     err = capsys.readouterr().err
-    assert "(MX,MY,1)" in err and "compensated" in err
+    assert "(MX,MY,1)" in err
 
 
 def test_cli_fuse_steps_sharded(tmp_path, capsys):
@@ -309,6 +381,57 @@ def test_cli_fuse_steps_auto_stays_single(tmp_path, capsys):
     capsys.readouterr()
 
 
+def test_cli_c2_field(tmp_path, capsys):
+    """--c2-field reaches the variable-c kernels end-to-end: presets and
+    .npy files run on single and sharded backends, the analytic oracle is
+    disabled with a notice, and misuse is rejected before compute."""
+    base = ["12", "1", "1", "1", "1", "1", "5"]
+    assert cli.main(
+        base + ["--c2-field", "gaussian-lens", "--backend", "single",
+                "--out-dir", str(tmp_path)]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "errors: disabled" in out
+    side = json.load(open(tmp_path / "output_N12_Np1_TPU.json"))
+    assert side["run_config"]["c2_field"] == "gaussian-lens"
+    assert side["errors_computed"] is False
+
+    # .npy file of c^2 values; the constant field must reproduce the
+    # constant-speed run's layers exactly (library collapse contract).
+    import numpy as np
+
+    from wavetpu.core.problem import Problem as _P
+
+    p = _P.from_argv(base)
+    npy = str(tmp_path / "c2.npy")
+    np.save(npy, np.full((12, 12, 12), p.a2))
+    assert cli.main(
+        base + ["--c2-field", npy, "--backend", "single",
+                "--out-dir", str(tmp_path / "npy")]
+    ) == 0
+    # Sharded backend composes with the field.
+    assert cli.main(
+        base + ["--c2-field", "two-layer", "--mesh", "2,2,1",
+                "--out-dir", str(tmp_path / "sh")]
+    ) == 0
+    capsys.readouterr()
+    assert os.path.exists(tmp_path / "sh" / "output_N12_Np4_TPU.txt")
+
+    # Misuse rejected before compute.
+    assert cli.main(base + ["--c2-field", "nope-not-a-preset"]) == 2
+    assert cli.main(
+        base + ["--c2-field", "constant", "--scheme", "compensated"]
+    ) == 2
+    assert cli.main(
+        base + ["--c2-field", "constant", "--fuse-steps", "2"]
+    ) == 2
+    np.save(str(tmp_path / "bad.npy"), np.zeros((3, 3, 3)))
+    assert cli.main(
+        base + ["--c2-field", str(tmp_path / "bad.npy")]
+    ) == 2
+    capsys.readouterr()
+
+
 def test_cli_debug_nans_flag(tmp_path):
     """--debug-nans enables jax's NaN trap for the solve (SURVEY section 5
     sanitizer row) and a stable run completes without a false trap."""
@@ -379,6 +502,8 @@ def test_cli_json_run_config(tmp_path, capsys):
         "fuse_steps": 4,
         "mesh": [2, 2, 1],
         "dtype": "bfloat16",
+        "v_dtype": None,
+        "c2_field": None,
         "distributed": False,
         "resumed": False,
     }

@@ -135,11 +135,13 @@ def test_bf16_state():
 
 
 def test_validation():
-    with pytest.raises(ValueError, match="N % shards"):
+    # Uneven configs whose pad-and-mask layout would leave the last
+    # shard empty are refused with guidance (not silently mis-sharded).
+    with pytest.raises(ValueError, match="pad-and-mask"):
         sharded_kfused.solve_sharded_kfused(
             Problem(N=18, timesteps=8), n_shards=4, k=2, interpret=True
         )
-    with pytest.raises(ValueError, match="shard depth"):
+    with pytest.raises(ValueError, match="pad-and-mask"):
         sharded_kfused.solve_sharded_kfused(
             Problem(N=16, timesteps=8), n_shards=8, k=4, interpret=True
         )
@@ -147,6 +149,127 @@ def test_validation():
         sharded_kfused.solve_sharded_kfused(
             Problem(N=16, timesteps=8), n_shards=2, k=1, interpret=True
         )
+
+
+# ---------------------------------------------------------------------------
+# Uneven N (pad-and-mask path): the remainder-folding analog of the
+# reference (mpi_sol.cpp:417-421) for the temporally blocked solver.
+# Real planes must stay BITWISE equal to the single-device 1-step pallas
+# path (which the even k-fused path is already pinned to).
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _single_1step(problem, dtype=jnp.float32):
+    from wavetpu.kernels import stencil_pallas
+    from wavetpu.solver import leapfrog
+
+    return leapfrog.solve(
+        problem, dtype=dtype,
+        step_fn=stencil_pallas.make_step_fn(interpret=True),
+    )
+
+
+@pytest.mark.parametrize("n,n_shards,k,timesteps", [
+    (15, 8, 2, 9),    # r = 1 < k: seam windows span two source shards
+    (30, 8, 2, 11),   # r = 2 = k: single-source uneven
+    (15, 1, 2, 9),    # single-shard uneven (k does not divide N)
+    (60, 8, 4, 11),   # k does not divide N/MX (the N=1000-on-8-chips shape)
+    (15, 2, 2, 12),   # two shards + 1-step remainder tail through kk=1
+])
+def test_uneven_matches_single_device_1step(n, n_shards, k, timesteps):
+    from wavetpu.solver import sharded
+
+    p = Problem(N=n, timesteps=timesteps)
+    want = _single_1step(p)
+    got = sharded_kfused.solve_sharded_kfused(
+        p, n_shards=n_shards, k=k, interpret=True
+    )
+    # Results ride the standard Topology layout (padded, P(x,y,z)) like
+    # every other sharded result; gather_fundamental strips the pad.
+    np.testing.assert_array_equal(
+        sharded.gather_fundamental(got.u_cur, p), np.asarray(want.u_cur)
+    )
+    np.testing.assert_array_equal(
+        sharded.gather_fundamental(got.u_prev, p), np.asarray(want.u_prev)
+    )
+    np.testing.assert_allclose(
+        got.abs_errors, want.abs_errors, rtol=1e-5, atol=1e-7
+    )
+
+
+def test_uneven_layout_properties():
+    p = Problem(N=15, timesteps=8)
+    bx, d, r = sharded_kfused.uneven_layout(p, 2, 8)
+    assert d % bx == 0 and bx % 2 == 0 and r >= 1
+    assert 7 * d < 15 <= 8 * d
+
+
+def test_uneven_stop_resume_bitwise():
+    p = Problem(N=15, timesteps=11)
+    full = sharded_kfused.solve_sharded_kfused(
+        p, n_shards=4, k=2, interpret=True
+    )
+    part = sharded_kfused.solve_sharded_kfused(
+        p, n_shards=4, k=2, stop_step=5, interpret=True
+    )
+    res = sharded_kfused.resume_sharded_kfused(
+        p, part.u_prev, part.u_cur, start_step=5, n_shards=4, k=2,
+        interpret=True,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res.u_cur), np.asarray(full.u_cur)
+    )
+    assert (res.abs_errors[:6] == 0).all()
+
+
+def test_uneven_checkpoint_roundtrip(tmp_path):
+    """Uneven results ride the canonical Topology layout, so the
+    per-shard checkpoint writer and loader consume them unchanged
+    (regression: the r5 review caught a sliced result whose collapsed
+    sharding made every device race-write shard_0_0_0)."""
+    from wavetpu.io import checkpoint as ckpt
+
+    p = Problem(N=15, timesteps=11)
+    full = sharded_kfused.solve_sharded_kfused(
+        p, n_shards=4, k=2, interpret=True
+    )
+    part = sharded_kfused.solve_sharded_kfused(
+        p, n_shards=4, k=2, stop_step=5, interpret=True
+    )
+    path = str(tmp_path / "ck")
+    ckpt.save_sharded_checkpoint(path, part)
+    problem2, u_prev, u_cur, step, mesh_shape, scheme, aux = (
+        ckpt.load_sharded_checkpoint(path)
+    )
+    assert mesh_shape == (4, 1, 1) and step == 5
+    res = sharded_kfused.resume_sharded_kfused(
+        problem2, np.asarray(u_prev), np.asarray(u_cur), start_step=step,
+        n_shards=4, k=2, interpret=True,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res.u_cur), np.asarray(full.u_cur)
+    )
+
+
+def test_uneven_no_errors_and_bf16():
+    from wavetpu.solver import sharded
+
+    p = Problem(N=15, timesteps=9)
+    got = sharded_kfused.solve_sharded_kfused(
+        p, n_shards=2, k=2, compute_errors=False, interpret=True
+    )
+    assert (got.abs_errors == 0).all()
+    want = _single_1step(p, jnp.bfloat16)
+    got16 = sharded_kfused.solve_sharded_kfused(
+        p, n_shards=2, dtype=jnp.bfloat16, k=2, interpret=True
+    )
+    np.testing.assert_array_equal(
+        sharded.gather_fundamental(
+            got16.u_cur.astype(jnp.float32), p
+        ),
+        np.asarray(want.u_cur.astype(jnp.float32)),
+    )
 
 
 @pytest.mark.parametrize("mesh,k,timesteps", [

@@ -29,10 +29,30 @@ pick at runtime):
   --scheme {standard,compensated}   time-integration scheme: compensated =
                                     Kahan incremental leapfrog, pushing f32
                                     to the discretization limit (5.7e-6 vs
-                                    1.1e-3 L-inf at N=512/1000 on v5e, at
-                                    ~12 vs ~20 Gcell/s); f32/f64, single or
-                                    sharded backend (checkpointable; no
-                                    --overlap/--phase-timing yet)
+                                    1.1e-3 L-inf at N=512/1000 on v5e);
+                                    composes with --fuse-steps K into the
+                                    FLAGSHIP velocity-form onion (~36
+                                    Gcell/s at 5.7e-6, single-device;
+                                    solver/kfused_comp.py); f32/f64, 1-step
+                                    form also on the sharded backend
+                                    (checkpointable; no --overlap /
+                                    --phase-timing)
+  --v-dtype {f32,bf16}              increment-stream dtype for the
+                                    compensated k-fused mode: bf16 = the
+                                    increment-form bf16 config (bf16 v +
+                                    f32 carrier u, carry-less; ~46 Gcell/s
+                                    at L-inf ~6e-4 - the bf16 mode whose
+                                    numbers mean something, vs the 0.66
+                                    garbage of a bf16 carrier state)
+  --c2-field PRESET|FILE.npy        spatially varying wave speed c^2(x,y,z):
+                                    a preset (constant, gaussian-lens,
+                                    two-layer) or an .npy file of c^2 values
+                                    on the fundamental (N,N,N) grid
+                                    (tau^2 applied internally).  Disables
+                                    the analytic-error oracle (no closed
+                                    form); standard scheme, no --fuse-steps
+                                    (VMEM budget, solver/kfused.py scope
+                                    note); single or sharded backend
   --kernel {auto,roll,pallas}       hot-kernel selection: pallas = the fused
                                     slab kernel (kernels/stencil_pallas.py,
                                     the analog of the reference shipping its
@@ -93,7 +113,7 @@ _KNOWN_FLAGS = (
     "backend", "mesh", "dtype", "no-errors", "out-dir", "platform",
     "phase-timing", "stop-step", "save-state", "resume",
     "kernel", "overlap", "scheme", "distributed", "profile",
-    "fuse-steps", "debug-nans",
+    "fuse-steps", "debug-nans", "v-dtype", "c2-field",
 )
 _VALUELESS = (
     "no-errors", "phase-timing", "overlap", "distributed", "debug-nans",
@@ -160,13 +180,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         fuse_steps = int(flags.get("fuse-steps", "1"))
         if fuse_steps < 1:
             raise ValueError(f"--fuse-steps must be >= 1, got {fuse_steps}")
+        v_dtype_flag = flags.get("v-dtype")
+        if v_dtype_flag is not None and v_dtype_flag not in ("f32", "bf16"):
+            raise ValueError(
+                f"--v-dtype must be f32|bf16, got {v_dtype_flag}"
+            )
+        if v_dtype_flag == "bf16" and (
+            scheme != "compensated" or fuse_steps < 2
+        ):
+            raise ValueError(
+                "--v-dtype bf16 is the increment-form bf16 mode: it "
+                "requires --scheme compensated --fuse-steps K (the bf16 "
+                "increment stream rides the velocity-form onion)"
+            )
         if fuse_steps > 1:
             if flags.get("kernel", "auto") == "roll":
                 raise ValueError("--fuse-steps needs the pallas kernel")
-            if scheme == "compensated":
+            if scheme == "compensated" and (
+                "mesh" in flags or flags.get("backend") == "sharded"
+            ):
                 raise ValueError(
-                    "--fuse-steps is not available for the compensated "
-                    "scheme"
+                    "compensated k-fusion (--scheme compensated "
+                    "--fuse-steps) runs on the single-device backend; "
+                    "drop --mesh / --backend sharded"
                 )
             if "mesh" in flags:
                 # k-fusion composes with (MX, MY, 1) decompositions; z is
@@ -194,6 +230,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     "--fuse-steps (whose exchange is amortized over k "
                     "layers)"
                 )
+        if "c2-field" in flags:
+            if scheme == "compensated":
+                raise ValueError(
+                    "--c2-field requires the standard scheme (the "
+                    "compensated kernels carry a scalar coefficient)"
+                )
+            if fuse_steps > 1:
+                raise ValueError(
+                    "--c2-field is not available with --fuse-steps: the "
+                    "field's own VMEM onion pushes every k >= 2 config "
+                    "over budget or to no-win block sizes "
+                    "(solver/kfused.py scope note)"
+                )
+            if "phase-timing" in flags:
+                raise ValueError(
+                    "--phase-timing's probe times the constant-c step; "
+                    "drop it for --c2-field runs"
+                )
         if flags.get("backend") == "single" and "mesh" in flags:
             raise ValueError("--mesh contradicts --backend single")
         if flags.get("backend") == "single" and "overlap" in flags:
@@ -219,6 +273,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "[--backend auto|single|sharded] [--mesh MX,MY,MZ] "
             "[--dtype f32|f64|bf16] [--kernel auto|roll|pallas] "
             "[--fuse-steps K] [--scheme standard|compensated] "
+            "[--v-dtype f32|bf16] [--c2-field PRESET|FILE.npy] "
             "[--overlap] [--no-errors] [--phase-timing] [--profile DIR] "
             "[--debug-nans] [--distributed] [--stop-step S] "
             "[--save-state PATH] [--resume PATH] "
@@ -391,19 +446,106 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             (mesh_shape or (_ck_mesh if resume_is_sharded else None)
              or (n_devices, 1, 1)) if backend == "sharded" else (1, 1, 1)
         )
+        _even_x = (
+            problem.N % _grid[0] == 0
+            and (problem.N // _grid[0]) % fuse_steps == 0
+        )
         if (
-            problem.N % _grid[0]
-            or (problem.N // _grid[0]) % fuse_steps
-            or problem.N % _grid[1]
+            problem.N % _grid[1]
             or problem.N // _grid[1] < fuse_steps
+            or (_grid[1] > 1 and not _even_x)
         ):
             print(
-                f"error: --fuse-steps {fuse_steps} must divide the "
-                f"per-shard x depth N/MX = {problem.N}/{_grid[0]} and "
-                f"fit the y depth N/MY = {problem.N}/{_grid[1]}",
+                f"error: --fuse-steps {fuse_steps} must fit the y depth "
+                f"N/MY = {problem.N}/{_grid[1]}; on 2D meshes it must "
+                f"also divide the x depth N/MX = {problem.N}/{_grid[0]} "
+                f"(uneven N is supported on (MX,1,1) meshes)",
                 file=sys.stderr,
             )
             return 2
+        if not _even_x:
+            if scheme == "compensated":
+                print(
+                    f"error: compensated k-fusion requires --fuse-steps "
+                    f"{fuse_steps} to divide N = {problem.N}",
+                    file=sys.stderr,
+                )
+                return 2
+            if "phase-timing" in flags:
+                print(
+                    "error: --phase-timing's k-fused probe covers even "
+                    "decompositions (k | N/MX); drop it for uneven N",
+                    file=sys.stderr,
+                )
+                return 2
+            # Uneven x decomposition: verify a pad-and-mask layout
+            # exists BEFORE compiling anything (solver/sharded_kfused.py
+            # handles the actual march; a (1,1,1) grid covers the
+            # single-device k-does-not-divide-N case).
+            from wavetpu.solver import sharded_kfused as _sk
+
+            try:
+                _sk.uneven_layout(problem, fuse_steps, _grid[0])
+            except ValueError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+
+    c2_field = None
+    if "c2-field" in flags:
+        import numpy as np
+
+        from wavetpu.kernels import stencil_ref
+
+        spec = flags["c2-field"]
+        a2 = problem.a2
+
+        def _gaussian_lens(x, y, z):
+            # A slow-speed lens: c^2 dips to a2/2 at the domain centre.
+            s2 = 2.0 * (problem.Lx / 8.0) ** 2
+            r2 = (
+                (x - problem.Lx / 2) ** 2
+                + (y - problem.Ly / 2) ** 2
+                + (z - problem.Lz / 2) ** 2
+            )
+            return a2 * (1.0 - 0.5 * np.exp(-r2 / s2))
+
+        presets = {
+            "constant": lambda x, y, z: a2 * np.ones_like(x + y + z),
+            "gaussian-lens": _gaussian_lens,
+            # A discontinuous interface: the far z half runs 2x faster.
+            "two-layer": lambda x, y, z: np.where(
+                z < problem.Lz / 2, a2, 2.0 * a2
+            ) + 0.0 * x + 0.0 * y,
+        }
+        if spec in presets:
+            c2_field = stencil_ref.make_c2tau2_field(problem, presets[spec])
+        else:
+            try:
+                arr = np.load(spec)
+            except Exception as e:
+                print(
+                    f"error: --c2-field {spec!r} is neither a preset "
+                    f"({', '.join(sorted(presets))}) nor a loadable .npy "
+                    f"file: {e}",
+                    file=sys.stderr,
+                )
+                return 2
+            if arr.shape != (problem.N,) * 3:
+                print(
+                    f"error: --c2-field array shape {arr.shape} != "
+                    f"{(problem.N,) * 3} (c^2 values on the fundamental "
+                    f"grid)",
+                    file=sys.stderr,
+                )
+                return 2
+            c2_field = np.asarray(arr, np.float64) * problem.tau**2
+        if compute_errors:
+            # The analytic oracle only holds for constant speed; a report
+            # of "errors" against it would be meaningless.  The constant
+            # preset keeps the same contract for uniformity (its library
+            # collapse to a2tau2 is pinned by tests/test_variable_c.py).
+            say("errors: disabled (--c2-field has no analytic oracle)")
+            compute_errors = False
 
     kernel = resolve_kernel(
         flags.get("kernel", "auto"), jax.default_backend()
@@ -427,7 +569,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if scheme == "compensated":
         bad = None
         if flags.get("dtype") == "bf16":
-            bad = "--dtype bf16 (compensated requires f32/f64)"
+            bad = ("--dtype bf16 (compensated requires an f32/f64 carrier; "
+                   "for a bf16 increment stream use --v-dtype bf16)")
         elif "overlap" in flags:
             bad = "--overlap"
         elif "phase-timing" in flags:
@@ -435,10 +578,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             # reporting its numbers against a compensated solve would
             # describe a program that never ran.
             bad = "--phase-timing"
-        elif fuse_steps > 1:
-            # Covers `--resume comp_ck --fuse-steps K`, where the scheme is
-            # inherited from the checkpoint after the flag-level check.
-            bad = "--fuse-steps"
+        elif fuse_steps > 1 and backend == "sharded":
+            # Covers `--resume sharded_comp_ck --fuse-steps K`: the
+            # velocity-form onion is single-device; the 1-step compensated
+            # sharded path remains available without --fuse-steps.
+            bad = "--fuse-steps on the sharded backend"
+        elif fuse_steps > 1 and problem.N % fuse_steps:
+            # Covers `--resume comp_ck --fuse-steps K` with K not
+            # dividing N: the scheme arrives from the checkpoint AFTER
+            # the flag-level divisibility check, which only sees
+            # scheme == "standard" there.
+            bad = (f"--fuse-steps {fuse_steps} (compensated k-fusion "
+                   f"requires it to divide N = {problem.N})")
         if bad:
             print(
                 f"error: {bad} is not available for the compensated "
@@ -523,6 +674,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 scheme=scheme,
                 comp_v=_v,
                 comp_carry=_c,
+                c2tau2_field=c2_field,
             )
             shape = _ck_mesh
         else:
@@ -535,6 +687,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 overlap=overlap,
                 stop_step=stop_step,
                 scheme=scheme,
+                c2tau2_field=c2_field,
             )
             from wavetpu.core.grid import choose_mesh_shape
 
@@ -549,7 +702,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if kernel == "pallas":
             from wavetpu.kernels import stencil_pallas
 
-            step_fn = stencil_pallas.make_step_fn(interpret=interpret)
+            step_fn = stencil_pallas.make_step_fn(
+                interpret=interpret, c2tau2_field=c2_field
+            )
+        elif c2_field is not None:
+            from wavetpu.kernels import stencil_ref as _sr
+
+            step_fn = _sr.make_variable_c_step(c2_field)
         if resume_state is not None:
             u_prev0, u_cur0, start = resume_state
             # Unless --dtype was given explicitly, resume in the dtype the
@@ -558,7 +717,34 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             resume_dtype = (
                 dtype if "dtype" in flags else jnp.dtype(u_cur0.dtype)
             )
-            if scheme == "compensated":
+            if scheme == "compensated" and fuse_steps > 1:
+                from wavetpu.solver import kfused_comp
+
+                _v, _c = _ck_aux
+                # A bf16 increment stream marks the carry-less
+                # increment-form checkpoint; its stored carry (zeros) is
+                # dropped.
+                inc = (
+                    jnp.dtype(_v.dtype) == jnp.bfloat16
+                    and jnp.dtype(resume_dtype) != jnp.bfloat16
+                )
+                if inc:
+                    # The sidecar must record the mode that actually ran,
+                    # not the (absent) flag.
+                    flags["v-dtype"] = "bf16"
+                result = kfused_comp.resume_kfused_comp(
+                    problem,
+                    u_cur0,
+                    _v,
+                    None if inc else _c,
+                    start_step=start,
+                    dtype=resume_dtype,
+                    k=fuse_steps,
+                    compute_errors=compute_errors,
+                    interpret=interpret,
+                    v_dtype=jnp.bfloat16 if inc else None,
+                )
+            elif scheme == "compensated":
                 comp_step_fn = None
                 if kernel == "pallas":
                     from wavetpu.kernels import stencil_pallas as _sp
@@ -576,6 +762,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     dtype=resume_dtype,
                     comp_step_fn=comp_step_fn,
                     compute_errors=compute_errors,
+                )
+            elif fuse_steps > 1 and problem.N % fuse_steps:
+                # Uneven single-device k-fusion runs the pad-and-mask
+                # path on a (1,1,1) grid (bitwise equal to the 1-step
+                # pallas march on real planes).
+                from wavetpu.solver import sharded_kfused
+
+                result = sharded_kfused.resume_sharded_kfused(
+                    problem,
+                    u_prev0,
+                    u_cur0,
+                    start_step=start,
+                    n_shards=1,
+                    dtype=resume_dtype,
+                    k=fuse_steps,
+                    compute_errors=compute_errors,
+                    interpret=interpret,
                 )
             elif fuse_steps > 1:
                 from wavetpu.solver import kfused
@@ -600,6 +803,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     step_fn=step_fn,
                     compute_errors=compute_errors,
                 )
+        elif scheme == "compensated" and fuse_steps > 1:
+            from wavetpu.solver import kfused_comp
+
+            v_bf16 = flags.get("v-dtype") == "bf16"
+            result = kfused_comp.solve_kfused_comp(
+                problem,
+                dtype=dtype,
+                k=fuse_steps,
+                compute_errors=compute_errors,
+                stop_step=stop_step,
+                interpret=interpret,
+                v_dtype=jnp.bfloat16 if v_bf16 else None,
+                carry=not v_bf16,
+            )
         elif scheme == "compensated":
             comp_step_fn = None
             if kernel == "pallas":
@@ -612,6 +829,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 comp_step_fn=comp_step_fn,
                 compute_errors=compute_errors,
                 stop_step=stop_step,
+            )
+        elif fuse_steps > 1 and problem.N % fuse_steps:
+            from wavetpu.solver import sharded_kfused
+
+            result = sharded_kfused.solve_sharded_kfused(
+                problem,
+                n_shards=1,
+                dtype=dtype,
+                k=fuse_steps,
+                compute_errors=compute_errors,
+                stop_step=stop_step,
+                interpret=interpret,
             )
         elif fuse_steps > 1:
             from wavetpu.solver import kfused
@@ -694,6 +923,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 # The state's actual dtype (a resumed run inherits the
                 # checkpoint's, which may differ from the flag default).
                 "dtype": jnp.dtype(result.u_cur.dtype).name,
+                "v_dtype": flags.get("v-dtype"),
+                "c2_field": flags.get("c2-field"),
                 "distributed": distributed,
                 "resumed": "resume" in flags,
             },

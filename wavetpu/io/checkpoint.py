@@ -79,8 +79,16 @@ def save_checkpoint(path: str, result: SolveResult) -> str:
         # Compensated-scheme state is three buffers: u, the increment v,
         # and the Kahan carry (u_prev is still stored for uniformity /
         # inspection, but the bitwise resume re-enters from (u, v, carry)).
+        # The carry-less increment form (bf16 v) stores zeros: a zero
+        # carry is a valid Kahan start, and the bf16 v dtype marks the
+        # mode for resume dispatch (cli.py).
+        import jax.numpy as jnp
+
         comp_v, v_tag = _encode_field(result.comp_v)
-        comp_carry, c_tag = _encode_field(result.comp_carry)
+        comp_carry, c_tag = _encode_field(
+            result.comp_carry if result.comp_carry is not None
+            else jnp.zeros_like(result.u_cur)
+        )
         extra = dict(
             scheme="compensated",
             comp_v=comp_v,
@@ -224,10 +232,16 @@ def save_sharded_checkpoint(path_dir: str, result: SolveResult) -> str:
         return {starts_of(s.index): s.data for s in arr.addressable_shards}
 
     prev_by_start = by_start(u_prev)
+    if compensated and result.comp_carry is None:
+        # Carry-less increment form: store a zero carry (a valid Kahan
+        # start; the bf16 v dtype marks the mode for resume dispatch).
+        import jax.numpy as jnp
+
+        carry_src = by_start(jnp.zeros_like(result.u_cur))
+    elif compensated:
+        carry_src = by_start(result.comp_carry)
     aux_by_start = (
-        (by_start(result.comp_v), by_start(result.comp_carry))
-        if compensated
-        else None
+        (by_start(result.comp_v), carry_src) if compensated else None
     )
     in_flight = []
     try:

@@ -821,8 +821,7 @@ def fused_kstep(u_prev, u, syz, rsyz, sxct, *, k, coeff, inv_h2,
 
 def choose_kstep_comp_block(
     n: int, k: int, u_itemsize: int = 4, v_itemsize: int = 4,
-    carry_itemsize: Optional[int] = 4, depth: Optional[int] = None,
-    ghosts: bool = False, plane_elems: Optional[int] = None,
+    carry_itemsize: Optional[int] = 4,
 ) -> Optional[int]:
     """Slab depth for the compensated/velocity-form k-step kernel.
 
@@ -837,23 +836,18 @@ def choose_kstep_comp_block(
     coefficient carries an extra safety margin (3.4) because its
     rejection boundary was measured, not its acceptance.
     """
-    if depth is None:
-        depth = n
-    if plane_elems is None:
-        plane_elems = n * n
+    plane_elems = n * n
     pb_f32 = plane_elems * 4
     state = u_itemsize + v_itemsize
     has_carry = carry_itemsize is not None
     best = None
     bx = k
-    while bx <= 8 and bx <= depth:
-        if depth % bx == 0:
+    while bx <= 8 and bx <= n:
+        if n % bx == 0:
             onion = bx + 2 * k
             pipeline = 2 * (onion + bx) * state * plane_elems
             if has_carry:
                 pipeline += 2 * 2 * bx * carry_itemsize * plane_elems
-            if ghosts:
-                pipeline += 4 * k * state * plane_elems
             planes = 4 * pb_f32
             temps = (315 if has_carry else 340) * onion * pb_f32 // 100
             if pipeline + planes + temps <= _KSTEP_COMP_VMEM_LIMIT:
@@ -1177,6 +1171,171 @@ def fused_kstep_sharded(u_prev, u, prev_ghosts, cur_ghosts, syz, rsyz, sxct,
     )(sxct, u_prev, u, u_prev, u_prev, u, u,
       prev_ghosts[0], prev_ghosts[1], cur_ghosts[0], cur_ghosts[1],
       syz, rsyz)
+    if with_errors:
+        return out
+    return out[0], out[1], None, None
+
+
+def _kstep_padded_kernel(*refs, k, bx, bk, coeff, inv_h2, compute_dtype,
+                         with_errors):
+    """k leapfrog substeps of an x-sharded block with UNEVEN real depth.
+
+    Operands are pre-assembled extended arrays (see
+    `fused_kstep_padded`): ext = [k lo-ghost planes | D local planes |
+    k junk planes], with the k hi-ghost planes written INTO the array at
+    offset k + n_real - so the x-neighbour chain of every real plane is
+    gap-free (the pad planes that would sit between the last real plane
+    and the ghosts in HBM layout are displaced past the ghosts, where no
+    real plane's k-cone reaches; junk beyond k + n_real + k is never
+    consumed).  Each program fetches its onion window as bk + 2
+    contiguous k-plane blocks of ext per field.
+
+    Consequences vs `_kstep_sharded_kernel`: no edge `pick` (ghosts are
+    baked into ext), no mid-onion x-mask (ghost slots hold REAL planes
+    that must keep evolving; the junk zone is never read by real cones),
+    and the store masks pad planes (local index >= n_real) to keep the
+    zero-pad carry invariant.  Per-plane op order is identical to
+    `_kstep_kernel`, so real planes stay bitwise equal to the 1-step
+    pallas path (tests/test_sharded_kfused.py uneven cases).
+    """
+    it = iter(refs)
+    nreal_ref = next(it)                       # SMEM (1,) int32
+    sxct_ref = next(it)                        # SMEM (k, D)
+    prev_parts = [next(it) for _ in range(bk + 2)]
+    cur_parts = [next(it) for _ in range(bk + 2)]
+    syz_ref, rsyz_ref = next(it), next(it)
+    out = list(it)
+    out_prev_ref, out_ref = out[0], out[1]
+    if with_errors:
+        dmax_ref, rmax_ref = out[2], out[3]
+
+    i = pl.program_id(0)
+    f = compute_dtype
+    n_real = nreal_ref[0]
+    ix, iy, iz = (jnp.asarray(v, f) for v in inv_h2)
+    prev = jnp.concatenate([p[:].astype(f) for p in prev_parts], 0)
+    cur = jnp.concatenate([p[:].astype(f) for p in cur_parts], 0)
+    ny, nz = cur.shape[1], cur.shape[2]
+    syz = syz_ref[:]
+    rsyz = rsyz_ref[:]
+
+    ym = lax.broadcasted_iota(jnp.int32, (1, ny, nz), 1) != 0
+    zm = lax.broadcasted_iota(jnp.int32, (1, ny, nz), 2) != 0
+    mask = ym & zm
+
+    for s in range(1, k + 1):
+        c = cur[1:-1]
+        lap = (cur[:-2] + cur[2:] - 2.0 * c) * ix
+        lap = lap + (
+            pltpu.roll(c, 1, 1) + pltpu.roll(c, ny - 1, 1) - 2.0 * c
+        ) * iy
+        lap = lap + (
+            pltpu.roll(c, 1, 2) + pltpu.roll(c, nz - 1, 2) - 2.0 * c
+        ) * iz
+        new = 2.0 * c + jnp.asarray(coeff, f) * lap - prev[1:-1]
+        new = jnp.where(mask, new, jnp.asarray(0.0, f))
+        if out_ref.dtype != f:
+            new = new.astype(out_ref.dtype).astype(f)
+        if with_errors:
+            ctr = new[k - s: k - s + bx]
+            for j in range(bx):
+                col = i * bx + j
+                # Pad columns must emit 0: their mid-onion values hold
+                # displaced ghost planes (real data at the wrong x), and
+                # their sxct is zero-padded.
+                real = col < n_real
+                diff = jnp.abs(ctr[j] - sxct_ref[s - 1, col] * syz)
+                dmax_ref[s - 1, col] = jnp.where(
+                    real, jnp.max(diff), 0.0
+                ).astype(jnp.float32)
+                rmax_ref[s - 1, col] = jnp.where(
+                    real, jnp.max(diff * rsyz), 0.0
+                ).astype(jnp.float32)
+        prev, cur = c, new
+
+    px = (
+        i * bx + lax.broadcasted_iota(jnp.int32, (bx, 1, 1), 0)
+    ) < n_real
+    out_prev_ref[:] = jnp.where(
+        px, prev, jnp.asarray(0.0, f)
+    ).astype(out_prev_ref.dtype)
+    out_ref[:] = jnp.where(
+        px, cur, jnp.asarray(0.0, f)
+    ).astype(out_ref.dtype)
+
+
+def fused_kstep_padded(ext_prev, ext_cur, n_real, syz, rsyz, sxct, *,
+                       k, coeff, inv_h2, block_x, interpret=False,
+                       with_errors=True, compute_dtype=None):
+    """k fused leapfrog steps of an uneven (pad-and-mask) x-sharded block.
+
+    Must run inside `shard_map` on an (MX, 1, 1) mesh (MX = 1 works too:
+    the caller assembles ghosts from local slices).  `ext_prev`/`ext_cur`
+    are (D + 2k, ny, nz) extended blocks: k exchanged lo-ghost planes,
+    the D-plane padded local block with the k hi-ghost planes written at
+    offset k + n_real (comm assembly in solver/sharded_kfused.py), and k
+    trailing junk planes.  `n_real` is this shard's real-plane count as
+    an int32 scalar array; `sxct` the (k, D) local oracle rows
+    (zero-padded columns).  Returns (u_prev, u) as (D, ny, nz) blocks
+    with pad planes zeroed, plus (k, D) error rows (zero at pad
+    columns).  `block_x` is required (the caller owns the D/bx/VMEM
+    trade; k must divide block_x, block_x must divide D).
+
+    This is the remainder-folding analog of the reference
+    (mpi_sol.cpp:417-421) for the temporally blocked path; the even-N
+    point-to-point path (`fused_kstep_sharded`) remains the flagship
+    fast path.  k=1 degenerates to a 1-step padded update (used for the
+    bootstrap and the remainder tail).
+    """
+    dtot, ny, nz = ext_cur.shape
+    bx = block_x
+    d = dtot - 2 * k
+    if compute_dtype is None:
+        compute_dtype = stencil_ref.compute_dtype(ext_cur.dtype)
+    if d % bx or bx % k:
+        raise ValueError(f"block_x={bx} must divide the padded depth {d} "
+                         f"and be a multiple of k={k}")
+    bk = bx // k
+    parts = [
+        pl.BlockSpec((k, ny, nz),
+                     (lambda t: (lambda i, _bk=bk, _t=t:
+                                 (i * _bk + _t, 0, 0)))(t),
+                     memory_space=pltpu.VMEM)
+        for t in range(bk + 2)
+    ]
+    out_slab = pl.BlockSpec((bx, ny, nz), lambda i: (i, 0, 0),
+                            memory_space=pltpu.VMEM)
+    plane = pl.BlockSpec((ny, nz), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM)
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    kern = functools.partial(
+        _kstep_padded_kernel, k=k, bx=bx, bk=bk, coeff=coeff,
+        inv_h2=inv_h2, compute_dtype=compute_dtype,
+        with_errors=with_errors,
+    )
+    state = _out_struct(ext_cur, shape=(d, ny, nz))
+    out_specs = [out_slab, out_slab]
+    out_shape = [state, state]
+    if with_errors:
+        err = _out_struct(ext_cur, shape=(k, d), dtype=jnp.float32)
+        out_specs += [smem, smem]
+        out_shape += [err, err]
+    in_specs = [smem, smem] + parts + parts + [plane, plane]
+    operands = (
+        [jnp.asarray(n_real, jnp.int32).reshape(1), sxct]
+        + [ext_prev] * (bk + 2) + [ext_cur] * (bk + 2) + [syz, rsyz]
+    )
+    out = pl.pallas_call(
+        kern,
+        grid=(d // bx,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_KSTEP_VMEM_LIMIT
+        ),
+        interpret=interpret,
+    )(*operands)
     if with_errors:
         return out
     return out[0], out[1], None, None

@@ -53,6 +53,44 @@ from wavetpu.solver import kfused, leapfrog
 from wavetpu.solver.leapfrog import SolveResult
 
 
+def _is_even(problem: Problem, k: int, n_x: int) -> bool:
+    """True when the x decomposition divides evenly (the point-to-point
+    flagship path); False routes to the pad-and-mask path."""
+    return problem.N % n_x == 0 and (problem.N // n_x) % k == 0
+
+
+def uneven_layout(problem: Problem, k: int, n_x: int, itemsize: int = 4):
+    """(bx, D, r) for the pad-and-mask x-only path.
+
+    D is the uniform padded per-shard depth (a multiple of the slab
+    depth bx, itself a multiple of k), chosen as the largest
+    VMEM-fitting bx with D = bx * ceil(N / (MX * bx)).  r = N - (MX-1)*D
+    is the last shard's real-plane count - the remainder-folding analog
+    of the reference (mpi_sol.cpp:417-421).  Raises when no layout keeps
+    every leading shard full AND the last shard non-empty (r >= 1): that
+    means the mesh is too large for N at this k - use fewer shards.
+    """
+    n = problem.N
+    best = None
+    bx = k
+    while bx <= 8:
+        d = bx * (-(-n // (n_x * bx)))  # bx * ceil(n / (n_x * bx))
+        r = n - (n_x - 1) * d
+        fits = stencil_pallas.choose_kstep_block(
+            n, k, itemsize, depth=d, ghosts=True
+        )
+        if r >= 1 and fits is not None and fits >= bx:
+            best = (bx, d, r)
+        bx *= 2
+    if best is None:
+        raise ValueError(
+            f"no pad-and-mask layout for N={n} over {n_x} x-shards at "
+            f"k={k}: every candidate leaves the last shard empty or "
+            f"exceeds VMEM; use fewer shards or a smaller k"
+        )
+    return best
+
+
 def _validate(problem: Problem, k: int, n_x: int, n_y: int = 1):
     if k < 2:
         raise ValueError(f"k must be >= 2 (got {k})")
@@ -60,15 +98,16 @@ def _validate(problem: Problem, k: int, n_x: int, n_y: int = 1):
         raise ValueError(
             f"mesh axes must be >= 1 (got MX={n_x}, MY={n_y})"
         )
-    if problem.N % n_x:
-        raise ValueError(
-            f"x-sharded k-fusion needs N % shards == 0 "
-            f"(N={problem.N}, shards={n_x})"
-        )
-    if (problem.N // n_x) % k:
-        raise ValueError(
-            f"k={k} must divide the shard depth {problem.N // n_x}"
-        )
+    if problem.N < k:
+        raise ValueError(f"k={k} exceeds N={problem.N}")
+    if not _is_even(problem, k, n_x):
+        if n_y > 1:
+            raise ValueError(
+                f"2D-mesh k-fusion needs N % MX == 0 and k | N/MX "
+                f"(N={problem.N}, MX={n_x}, k={k}); uneven N is "
+                f"supported on (MX, 1, 1) meshes"
+            )
+        uneven_layout(problem, k, n_x)  # raises if no layout exists
     if problem.N % n_y:
         raise ValueError(
             f"y-sharded k-fusion needs N % y-shards == 0 "
@@ -293,6 +332,269 @@ def _make_runner(
     return jax.jit(run), None
 
 
+def _make_padded_runner(
+    problem: Problem,
+    mesh,
+    n_x: int,
+    dtype,
+    k: int,
+    compute_errors: bool,
+    nsteps: int,
+    start_step: Optional[int],
+    block_x: Optional[int],
+    interpret: bool,
+):
+    """Pad-and-mask x-only runner for uneven decompositions.
+
+    Covers N % MX != 0 and/or k not dividing N/MX (the reference folds
+    the remainder into the last rank, mpi_sol.cpp:417-421).  Every shard
+    holds a uniform padded depth D; ghosts are true cyclic REAL planes,
+    assembled from up to two source shards when the last shard owns
+    fewer than k real planes (one extra two-hop ppermute pair, built
+    only when r < k), and each block is locally extended to
+    [lo(k) | D | junk(k)] with the hi ghost spliced at the real boundary
+    (see stencil_pallas.fused_kstep_padded).  The runner's raw outputs
+    are (MX*D, N, N) globals; solve/resume re-place them on the 1-step
+    sharded path's Topology layout so checkpointing, gather_fundamental
+    and every downstream consumer see the SAME convention as all other
+    sharded results.
+
+    Cost: the per-block ext assembly (concat + hi-ghost splice) is one
+    extra memory pass over both fields per k layers (~+4/k field-streams
+    per step).  Measured on v5e at N=510/1000 k=4: 26.9 Gcell/s vs 44.9
+    for the even point-to-point path and 20.3 for the 1-step kernel -
+    the fallback is still a clear win over not fusing.
+    """
+    f = stencil_ref.compute_dtype(dtype)
+    n = problem.N
+    bx, d, r = uneven_layout(
+        problem, k, n_x, jnp.dtype(dtype).itemsize
+    )
+    if block_x is not None:
+        bx = block_x
+        d = bx * (-(-n // (n_x * bx)))
+        r = n - (n_x - 1) * d
+        if r < 1 or d % bx or bx % k:
+            raise ValueError(
+                f"block_x={bx} gives no valid pad-and-mask layout for "
+                f"N={n} over {n_x} shards at k={k}"
+            )
+    dg = n_x * d
+    pad = dg - n
+    sx, ct, syz, rsyz, xmask, inv_absx = kfused._oracle_parts(problem, f)
+    zpad = jnp.zeros((pad,), f)
+    sx_p = jnp.concatenate([sx, zpad])
+    xmask_p = jnp.concatenate([xmask, jnp.zeros((pad,), bool)])
+    inv_absx_p = jnp.concatenate([inv_absx, zpad])
+    padded_parts = (sx_p, ct, syz, rsyz, xmask_p, inv_absx_p)
+    sxct_all = ct[:, None] * sx_p[None, :]          # (T+1, MX*D)
+    perm_fwd = [(i, (i + 1) % n_x) for i in range(n_x)]
+    perm_bwd = [(i, (i - 1) % n_x) for i in range(n_x)]
+    perm_fwd2 = [(i, (i + 2) % n_x) for i in range(n_x)]
+    perm_bwd2 = [(i, (i - 2) % n_x) for i in range(n_x)]
+    coeff = problem.a2tau2
+    start = 1 if start_step is None else start_step
+    nblocks = (nsteps - start) // k
+    rem = (nsteps - start) - nblocks * k
+    multi = n_x > 1
+
+    def nm_scalar():
+        if not multi:
+            return jnp.int32(r)
+        return jnp.where(
+            lax.axis_index("x") == n_x - 1, r, d
+        ).astype(jnp.int32)
+
+    def ghosts(up, uc, kk):
+        """True cyclic real-plane ghosts, stacked (2, kk, N, N).
+
+        lo = the kk real planes globally preceding this shard's start,
+        hi = the kk real planes following its real end.  When the last
+        shard owns r < kk real planes, the seam windows span two source
+        shards; the static r makes the piece sizes static, so two extra
+        two-hop ppermutes + concats assemble them.
+        """
+        both = jnp.stack([up, uc])
+        if not multi:
+            lo = lax.dynamic_slice_in_dim(both, r - kk, kk, 1)
+            hi = lax.slice_in_dim(both, 0, kk, axis=1)
+            return lo, hi
+        ai = lax.axis_index("x")
+        tail_start = jnp.where(ai == n_x - 1, max(r - kk, 0), d - kk)
+        tail = lax.dynamic_slice_in_dim(both, tail_start, kk, 1)
+        head = lax.slice_in_dim(both, 0, kk, axis=1)
+        lo = lax.ppermute(tail, "x", perm_fwd)
+        hi = lax.ppermute(head, "x", perm_bwd)
+        if r < kk:
+            lo2 = lax.ppermute(tail, "x", perm_fwd2)
+            hi2 = lax.ppermute(head, "x", perm_bwd2)
+            # Shard 0's lo window = [N-kk, N): the last shard's r real
+            # planes preceded by the second-to-last shard's tail.
+            lo0 = jnp.concatenate([lo2[:, r:], lo[:, :r]], axis=1)
+            lo = jnp.where(ai == 0, lo0, lo)
+            # Shard MX-2's hi window = the last shard's r real planes
+            # followed by shard 0's head (the cyclic wrap).
+            him = jnp.concatenate([hi[:, :r], hi2[:, :kk - r]], axis=1)
+            hi = jnp.where(ai == n_x - 2, him, hi)
+        return lo, hi
+
+    def build_ext(field, lo_f, hi_f, nm, kk):
+        ny, nz = field.shape[1], field.shape[2]
+        ext = jnp.concatenate(
+            [lo_f, field, jnp.zeros((kk, ny, nz), field.dtype)], 0
+        )
+        z = jnp.int32(0)
+        return lax.dynamic_update_slice(
+            ext, hi_f, (jnp.int32(kk) + nm, z, z)
+        )
+
+    def kcall(syz_c, rsyz_c, up, uc, sxct_k, kk, with_err):
+        nm = nm_scalar()
+        lo, hi = ghosts(up, uc, kk)
+        ep = build_ext(up, lo[0], hi[0], nm, kk)
+        ec = build_ext(uc, lo[1], hi[1], nm, kk)
+        return stencil_pallas.fused_kstep_padded(
+            ep, ec, nm, syz_c, rsyz_c, sxct_k, k=kk, coeff=coeff,
+            inv_h2=problem.inv_h2, block_x=bx, interpret=interpret,
+            with_errors=with_err,
+        )
+
+    def layer_rows(syz_c, rsyz_c, u, sxct_row):
+        diff = jnp.abs(
+            u.astype(f) - sxct_row[:, None, None] * syz_c[None]
+        )
+        dd = jnp.max(diff, axis=(1, 2))[None]
+        rr = jnp.max(diff * rsyz_c[None], axis=(1, 2))[None]
+        return dd, rr
+
+    def local_march(syz_c, rsyz_c, u_prev, u, sxct_loc, first):
+        rows_d, rows_r = [], []
+
+        def body(carry, nstart):
+            u_prev, u = carry
+            sxct_k = lax.dynamic_slice(sxct_loc, (nstart + 1, 0), (k, d))
+            up, uc, dm, rm = kcall(
+                syz_c, rsyz_c, u_prev, u, sxct_k, k, compute_errors
+            )
+            if not compute_errors:
+                dm = rm = jnp.zeros((k, d), f)
+            return (up, uc), (dm, rm)
+
+        starts = first + k * jnp.arange(nblocks)
+        (u_prev, u), (dmb, rmb) = lax.scan(body, (u_prev, u), starts)
+        rows_d.append(dmb.reshape(-1, d))
+        rows_r.append(rmb.reshape(-1, d))
+        for t in range(rem):
+            layer = nsteps - rem + 1 + t
+            sxct_1 = lax.dynamic_slice(sxct_loc, (layer, 0), (1, d))
+            u_prev, u, dm, rm = kcall(
+                syz_c, rsyz_c, u_prev, u, sxct_1, 1, compute_errors
+            )
+            if not compute_errors:
+                dm = rm = jnp.zeros((1, d), f)
+            rows_d.append(dm)
+            rows_r.append(rm)
+        return u_prev, u, jnp.concatenate(rows_d), jnp.concatenate(rows_r)
+
+    state_spec = P("x")
+    rows_spec = P(None, "x")
+    plane_spec = P(None, None)
+
+    def assemble(dmax, rmax):
+        if compute_errors:
+            return _assemble_errors(padded_parts, dmax, rmax)
+        z = jnp.zeros((nsteps + 1,), f)
+        return z, z
+
+    if start_step is None:
+
+        def local(u0, sxct_loc, syz_c, rsyz_c):
+            _, s0, _, _ = kcall(
+                syz_c, rsyz_c, u0, u0, jnp.zeros((1, d), f), 1, False
+            )
+            u1 = (0.5 * (u0.astype(f) + s0.astype(f))).astype(dtype)
+            if compute_errors:
+                d1, r1 = layer_rows(syz_c, rsyz_c, u1, sxct_loc[1])
+            else:
+                d1 = r1 = jnp.zeros((1, d), f)
+            u_prev, u, rows_d, rows_r = local_march(
+                syz_c, rsyz_c, u0, u1, sxct_loc, 1
+            )
+            zero = jnp.zeros((1, d), f)
+            return (
+                u_prev, u,
+                jnp.concatenate([zero, d1, rows_d]),
+                jnp.concatenate([zero, r1, rows_r]),
+            )
+
+        local_fn = jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(state_spec, rows_spec, plane_spec, plane_spec),
+            out_specs=(state_spec, state_spec, rows_spec, rows_spec),
+            check_vma=False,
+        )
+
+        def run():
+            u0 = jnp.pad(
+                leapfrog.initial_layer0(problem, dtype),
+                ((0, pad), (0, 0), (0, 0)),
+            )
+            u0 = lax.with_sharding_constraint(
+                u0, NamedSharding(mesh, state_spec)
+            )
+            u_prev, u, dmax, rmax = local_fn(u0, sxct_all, syz, rsyz)
+            abs_e, rel_e = assemble(dmax, rmax)
+            return u_prev, u, abs_e, rel_e
+
+        return jax.jit(run), (dg, pad)
+
+    def local_resume(u_prev, u, sxct_loc, syz_c, rsyz_c):
+        u_prev, u, rows_d, rows_r = local_march(
+            syz_c, rsyz_c, u_prev, u, sxct_loc, start_step
+        )
+        head = jnp.zeros((start_step + 1, d), f)
+        return (
+            u_prev, u,
+            jnp.concatenate([head, rows_d]),
+            jnp.concatenate([head, rows_r]),
+        )
+
+    local_fn = jax.shard_map(
+        local_resume, mesh=mesh,
+        in_specs=(state_spec, state_spec, rows_spec, plane_spec,
+                  plane_spec),
+        out_specs=(state_spec, state_spec, rows_spec, rows_spec),
+        check_vma=False,
+    )
+
+    def run(u_prev, u):
+        u_prev, u, dmax, rmax = local_fn(u_prev, u, sxct_all, syz, rsyz)
+        abs_e, rel_e = assemble(dmax, rmax)
+        return u_prev, u, abs_e, rel_e
+
+    return jax.jit(run), (dg, pad)
+
+
+def _to_topology_layout(u, problem: Problem, mesh, n_x: int):
+    """Re-place a padded-runner global (MX*D, N, N) field on the standard
+    Topology layout (MX*ceil(N/MX) planes, P(x,y,z)-sharded).
+
+    The padded runner's D is kernel-driven (a multiple of bx) and differs
+    from Topology's ceil block, so its outputs cannot be checkpointed
+    per-shard as-is (slicing to N outside jit collapses the sharding and
+    every device would claim shard starts (0,0,0)).  One device_put onto
+    the canonical layout makes uneven k-fused results indistinguishable
+    from every other sharded result: save_sharded_checkpoint,
+    gather_fundamental and resume all consume them unchanged.
+    """
+    from wavetpu.core.grid import AXIS_NAMES, Topology
+
+    topo = Topology(N=problem.N, mesh_shape=(n_x, 1, 1))
+    padx = topo.padded[0] - problem.N
+    a = jnp.pad(u[: problem.N], ((0, padx), (0, 0), (0, 0)))
+    return jax.device_put(a, NamedSharding(mesh, P(*AXIS_NAMES)))
+
+
 def _resolve_grid(mesh_shape, n_shards, devices):
     """(n_x, n_y) from an explicit (MX, MY, 1) mesh_shape, the x-only
     n_shards shorthand, or all visible devices."""
@@ -335,15 +637,26 @@ def solve_sharded_kfused(
             f"stop_step must be in [1, {problem.timesteps}], got {nsteps}"
         )
     mesh = build_mesh((n_x, n_y, 1), devices[: n_x * n_y])
-    runner, _ = _make_runner(
-        problem, mesh, (n_x, n_y), dtype, k, compute_errors, nsteps,
-        None, block_x, interpret,
-    )
+    if _is_even(problem, k, n_x):
+        runner, _ = _make_runner(
+            problem, mesh, (n_x, n_y), dtype, k, compute_errors, nsteps,
+            None, block_x, interpret,
+        )
+        sliced = False
+    else:
+        runner, _ = _make_padded_runner(
+            problem, mesh, n_x, dtype, k, compute_errors, nsteps,
+            None, block_x, interpret,
+        )
+        sliced = True
     (u_prev, u_cur, abs_all, rel_all), init_s, solve_s = (
         leapfrog._timed_compile_run(
             runner, (), sync=lambda out: np.asarray(out[2])
         )
     )
+    if sliced:
+        u_prev = _to_topology_layout(u_prev, problem, mesh, n_x)
+        u_cur = _to_topology_layout(u_cur, problem, mesh, n_x)
     return SolveResult(
         problem=problem,
         u_prev=u_prev,
@@ -389,20 +702,42 @@ def resume_sharded_kfused(
             f"start_step must be in [1, {nsteps}], got {start_step}"
         )
     mesh = build_mesh((n_x, n_y, 1), devices[: n_x * n_y])
-    runner, _ = _make_runner(
-        problem, mesh, (n_x, n_y), dtype, k, compute_errors, nsteps,
-        start_step, block_x, interpret,
-    )
-    sharding = NamedSharding(mesh, P("x", "y"))
-    args = (
-        jax.device_put(jnp.asarray(u_prev, dtype), sharding),
-        jax.device_put(jnp.asarray(u_cur, dtype), sharding),
-    )
+    sliced = not _is_even(problem, k, n_x)
+    if not sliced:
+        runner, _ = _make_runner(
+            problem, mesh, (n_x, n_y), dtype, k, compute_errors, nsteps,
+            start_step, block_x, interpret,
+        )
+        sharding = NamedSharding(mesh, P("x", "y"))
+        args = (
+            jax.device_put(jnp.asarray(u_prev, dtype), sharding),
+            jax.device_put(jnp.asarray(u_cur, dtype), sharding),
+        )
+    else:
+        runner, (dg, _) = _make_padded_runner(
+            problem, mesh, n_x, dtype, k, compute_errors, nsteps,
+            start_step, block_x, interpret,
+        )
+        sharding = NamedSharding(mesh, P("x"))
+        padw = ((0, dg - problem.N), (0, 0), (0, 0))
+        args = (
+            jax.device_put(
+                jnp.pad(jnp.asarray(u_prev, dtype)[: problem.N], padw),
+                sharding,
+            ),
+            jax.device_put(
+                jnp.pad(jnp.asarray(u_cur, dtype)[: problem.N], padw),
+                sharding,
+            ),
+        )
     (u_p, u_c, abs_all, rel_all), init_s, solve_s = (
         leapfrog._timed_compile_run(
             runner, args, sync=lambda out: np.asarray(out[2])
         )
     )
+    if sliced:
+        u_p = _to_topology_layout(u_p, problem, mesh, n_x)
+        u_c = _to_topology_layout(u_c, problem, mesh, n_x)
     return SolveResult(
         problem=problem,
         u_prev=u_p,
