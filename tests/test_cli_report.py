@@ -467,11 +467,10 @@ def test_cli_resumed_kfused_phase_timing_uses_checkpoint_mesh(
     assert "total loop time:" in capsys.readouterr().out
 
 
-def test_cli_resumed_xy_kfused_phase_timing_rejected_presolve(
-    tmp_path, capsys
-):
-    """--phase-timing with a 2D-mesh k-fused checkpoint must fail BEFORE
-    the (potentially long) resume solve, with a clean exit."""
+def test_cli_resumed_xy_kfused_phase_timing(tmp_path, capsys):
+    """--phase-timing now covers 2D-mesh k-fused runs (round-5): a
+    resumed (2,2,1) checkpoint probes the xy program and reports the
+    split."""
     ck = str(tmp_path / "ck")
     assert cli.main(
         ["16", "1", "1", "1", "1", "1", "8", "--fuse-steps", "4",
@@ -479,9 +478,11 @@ def test_cli_resumed_xy_kfused_phase_timing_rejected_presolve(
          "--out-dir", str(tmp_path)]
     ) == 0
     assert cli.main(
-        ["--resume", ck, "--fuse-steps", "4", "--phase-timing"]
-    ) == 2
-    assert "x-only" in capsys.readouterr().err
+        ["--resume", ck, "--fuse-steps", "4", "--phase-timing",
+         "--out-dir", str(tmp_path / "res")]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "total loop time:" in out and "total ICI exchange time:" in out
 
 
 def test_cli_json_run_config(tmp_path, capsys):

@@ -67,6 +67,62 @@ _SCRIPT = textwrap.dedent("""
         atol=1e-5, rtol=0,
     )
     print("kfused mesh (8,8,1) OK")
+
+    # BASELINE config 5 (stretch) composition, scaled down: sharded +
+    # bf16 state + variable c + per-shard checkpoint/resume on the
+    # (8,8,4)-family mesh shape (here (4,4,4) to keep N small).  There
+    # is no analytic oracle for variable c, so the gate pins (a) the
+    # resumed state equals the uninterrupted run's bitwise, and (b) the
+    # bf16 run tracks an f32 run of the same config to bf16 precision.
+    import tempfile
+    import jax.numpy as jnp
+    from wavetpu.io import checkpoint as ckpt
+    from wavetpu.kernels import stencil_ref
+
+    # T/timesteps keep max(c)*tau*sqrt(3)/h ~ 0.69 < 1 (the variable
+    # field's own Courant bound; c^2 in [0.6, 1] here).
+    p3 = Problem(N=16, Np=1, Lx=1.0, Ly=1.0, Lz=1.0, T=0.25, timesteps=10)
+    c2 = stencil_ref.make_c2tau2_field(
+        p3, lambda x, y, z: 1.0 - 0.4 * np.exp(
+            -((x - 0.5) ** 2 + (y - 0.5) ** 2 + (z - 0.5) ** 2) / 0.08
+        )
+    )
+
+    def stretch(dtype, stop=None):
+        return sharded.solve_sharded(
+            p3, mesh_shape=(4, 4, 4), dtype=dtype, kernel="pallas",
+            c2tau2_field=np.asarray(c2), compute_errors=False,
+            stop_step=stop,
+        )
+
+    full16 = stretch(jnp.bfloat16)
+    part16 = stretch(jnp.bfloat16, stop=5)
+    with tempfile.TemporaryDirectory() as d:
+        path = ckpt.save_sharded_checkpoint(d + "/ck", part16)
+        p3b, u_prev, u_cur, step, mesh_shape, scheme, aux = (
+            ckpt.load_sharded_checkpoint(path)
+        )
+        assert step == 5 and mesh_shape == (4, 4, 4)
+        res16 = sharded.resume_sharded(
+            p3b, u_prev, u_cur, start_step=step, mesh_shape=mesh_shape,
+            dtype=jnp.bfloat16, kernel="pallas",
+            c2tau2_field=np.asarray(c2), compute_errors=False,
+        )
+    got = sharded.gather_fundamental(
+        res16.u_cur.astype(jnp.float32), p3
+    )
+    np.testing.assert_array_equal(
+        got,
+        sharded.gather_fundamental(full16.u_cur.astype(jnp.float32), p3),
+    )
+    full32 = stretch(jnp.float32)
+    np.testing.assert_allclose(
+        got,
+        sharded.gather_fundamental(full32.u_cur, p3),
+        atol=0.02, rtol=0,
+    )
+    assert np.isfinite(got).all()
+    print("stretch composition (bf16+var-c+checkpoint, (4,4,4)) OK")
 """)
 
 
@@ -85,3 +141,6 @@ def test_64_device_meshes():
     assert "mesh (4,4,4) x 64 devices OK" in proc.stdout
     assert "kfused mesh (64,1,1) OK" in proc.stdout
     assert "kfused mesh (8,8,1) OK" in proc.stdout
+    assert "stretch composition (bf16+var-c+checkpoint, (4,4,4)) OK" in (
+        proc.stdout
+    )

@@ -71,9 +71,30 @@ def test_phase_breakdown_kfused(small_problem):
     assert pb.steps_measured == 8  # 2 blocks x k=4 layers
 
 
+def test_phase_breakdown_kfused_xy_mesh(small_problem):
+    """The k-fused probe covers (MX, MY, 1) meshes (round-5): the
+    y-extended-block program is timed exactly as production runs it."""
+    pb = timing.measure_phase_breakdown(
+        small_problem, mesh_shape=(2, 2, 1), fuse_steps=4,
+        iters=2, repeats=1,
+    )
+    assert pb.loop_seconds > 0.0
+    assert pb.exchange_seconds >= 0.0
+    assert pb.steps_measured == 8
+
+
 def test_phase_breakdown_kfused_rejects_3d_mesh(small_problem):
-    with pytest.raises(ValueError, match="x-only"):
+    with pytest.raises(ValueError, match=r"\(MX, MY, 1\)"):
         timing.measure_phase_breakdown(
-            small_problem, mesh_shape=(2, 2, 1), fuse_steps=4,
+            small_problem, mesh_shape=(2, 2, 2), fuse_steps=4,
             iters=1, repeats=1,
+        )
+    with pytest.raises(ValueError, match="even"):
+        # Uneven decompositions have no probe (CLI rejects the combo).
+        timing.measure_phase_breakdown(
+            type(small_problem)(
+                N=small_problem.N - 1, Np=1, Lx=1.0, Ly=1.0, Lz=1.0,
+                T=1.0, timesteps=small_problem.timesteps,
+            ),
+            mesh_shape=(2, 1, 1), fuse_steps=4, iters=1, repeats=1,
         )

@@ -219,11 +219,6 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "--fuse-steps supports (MX,MY,1) meshes "
                         f"(MX, MY >= 1, MZ = 1); got {flags['mesh']}"
                     )
-                if len(_m) == 3 and _m[1] > 1 and "phase-timing" in flags:
-                    raise ValueError(
-                        "--phase-timing's k-fused probe covers x-only "
-                        "meshes; drop it or use --mesh MX,1,1"
-                    )
             if "overlap" in flags:
                 raise ValueError(
                     "--overlap applies to the 1-step sharded backend, not "
@@ -317,19 +312,6 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     print(
                         f"error: --fuse-steps supports (MX,MY,1) meshes; "
                         f"the checkpoint was saved on {_ck_mesh}",
-                        file=sys.stderr,
-                    )
-                    return 2
-                if (
-                    fuse_steps > 1 and _ck_mesh[1] > 1
-                    and "phase-timing" in flags
-                ):
-                    # Same pre-solve placement as the explicit --mesh
-                    # check: the probe must not fail AFTER a long solve.
-                    print(
-                        "error: --phase-timing's k-fused probe covers "
-                        f"x-only meshes; the checkpoint was saved on "
-                        f"{_ck_mesh}",
                         file=sys.stderr,
                     )
                     return 2

@@ -116,25 +116,30 @@ def _time_best(fn, args, repeats: int) -> float:
     return best
 
 
-def _kfused_probe_runner(problem, n_shards, mesh, dtype, k, interpret,
+def _kfused_probe_runner(problem, grid, mesh, dtype, k, interpret,
                          with_halo, iters: int):
-    """Jitted scan of `iters` PRODUCTION k-blocks over x-sharded state.
+    """Jitted scan of `iters` PRODUCTION k-blocks over (MX, MY)-sharded
+    state.
 
-    `with_halo=False` substitutes the shard's own wrap planes for the
-    ppermute'd ghosts - identical FLOPs and kernel, no ICI - mirroring
-    `_probe_runner`'s exchange=False contract for the k-fused solver
-    (whose exchange is one k-plane ppermute pair per field per k layers).
+    `with_halo=False` substitutes the shard's own wrap planes/rows for
+    EVERY ppermute (x ghosts, and on 2D meshes the y-row extension whose
+    x ghosts are then sliced from the extended blocks) - identical FLOPs
+    and kernel, no ICI - mirroring `_probe_runner`'s exchange=False
+    contract for the k-fused solver (whose exchange is one k-deep
+    ppermute pair per axis per field per k layers).
     """
-    from wavetpu.solver import kfused as _kfused
     from wavetpu.kernels import stencil_pallas as _sp
 
+    n_x, n_y = grid
     f = stencil_ref.compute_dtype(dtype)
-    nl = problem.N // n_shards
-    _, _, syz, rsyz, _, _ = _kfused._oracle_parts(problem, f)
-    perm_fwd = [(i, (i + 1) % n_shards) for i in range(n_shards)]
-    perm_bwd = [(i, (i - 1) % n_shards) for i in range(n_shards)]
+    nl = problem.N // n_x
+    nl_y = problem.N // n_y
+    perm_fwd = [(i, (i + 1) % n_x) for i in range(n_x)]
+    perm_bwd = [(i, (i - 1) % n_x) for i in range(n_x)]
+    perm_fwd_y = [(i, (i + 1) % n_y) for i in range(n_y)]
+    perm_bwd_y = [(i, (i - 1) % n_y) for i in range(n_y)]
 
-    def local(u_prev, u, salt):
+    def local(u_prev, u, syz_c, rsyz_c, salt):
         def ghosts(a):
             if with_halo:
                 return (
@@ -143,14 +148,33 @@ def _kfused_probe_runner(problem, n_shards, mesh, dtype, k, interpret,
                 )
             return a[-k:], a[:k]
 
+        def extend_y(a):
+            if with_halo:
+                lo = lax.ppermute(a[:, -k:], "y", perm_fwd_y)
+                hi = lax.ppermute(a[:, :k], "y", perm_bwd_y)
+            else:
+                lo, hi = a[:, -k:], a[:, :k]
+            return jnp.concatenate([lo, a, hi], axis=1)
+
         def body(carry, _):
             u_prev, u = carry
-            up, uc, _, _ = _sp.fused_kstep_sharded(
-                u_prev, u, ghosts(u_prev), ghosts(u), syz, rsyz,
-                jnp.zeros((k, nl), f), k=k, coeff=problem.a2tau2,
-                inv_h2=problem.inv_h2, interpret=interpret,
-                with_errors=False,
-            )
+            if n_y == 1:
+                up, uc, _, _ = _sp.fused_kstep_sharded(
+                    u_prev, u, ghosts(u_prev), ghosts(u), syz_c, rsyz_c,
+                    jnp.zeros((k, nl), f), k=k, coeff=problem.a2tau2,
+                    inv_h2=problem.inv_h2, interpret=interpret,
+                    with_errors=False,
+                )
+            else:
+                pe, ce = extend_y(u_prev), extend_y(u)
+                y0 = lax.axis_index("y") * nl_y
+                up, uc, _, _ = _sp.fused_kstep_sharded_xy(
+                    pe, ce, ghosts(pe), ghosts(ce), syz_c, rsyz_c,
+                    jnp.zeros((k, nl), f), y0, problem.N, k=k,
+                    nl_y=nl_y, coeff=problem.a2tau2,
+                    inv_h2=problem.inv_h2, interpret=interpret,
+                    with_errors=False,
+                )
             return (up, uc), None
 
         (u_prev, u), _ = jax.lax.scan(
@@ -158,12 +182,14 @@ def _kfused_probe_runner(problem, n_shards, mesh, dtype, k, interpret,
         )
         return jax.lax.psum(jnp.sum(u), AXIS_NAMES)
 
-    spec = P("x")
+    state_spec = P("x", "y")
+    plane_spec = P("y", None)
     return jax.jit(
         jax.shard_map(
             local,
             mesh=mesh,
-            in_specs=(spec, spec, P()),
+            in_specs=(state_spec, state_spec, plane_spec, plane_spec,
+                      P()),
             out_specs=P(),
             check_vma=False,
         )
@@ -187,8 +213,9 @@ def measure_phase_breakdown(
     Runs on zero state - leapfrog cost is data-independent, and the probes
     exist for timing, not numerics.  `kernel`/`overlap` select the same
     step the production solver would run; `fuse_steps > 1` probes the
-    x-sharded k-fused program instead (mesh must be x-only; `iters` then
-    counts k-blocks and the breakdown is scaled by the layers they cover).
+    sharded k-fused program instead (any even (MX, MY, 1) decomposition;
+    `iters` then counts k-blocks and the breakdown is scaled by the
+    layers they cover).
     """
     if devices is None:
         devices = jax.devices()
@@ -197,33 +224,43 @@ def measure_phase_breakdown(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if fuse_steps > 1:
+        from wavetpu.solver import kfused as _kfused
         from wavetpu.solver import sharded_kfused as _skf
 
         k = fuse_steps
-        n_shards = mesh_shape[0]
-        if mesh_shape[1:] != (1, 1):
+        n_x, n_y = mesh_shape[0], mesh_shape[1]
+        if mesh_shape[2] != 1:
             raise ValueError(
-                f"k-fused probe needs an x-only mesh, got {mesh_shape}"
+                f"k-fused probe needs an (MX, MY, 1) mesh, got {mesh_shape}"
             )
-        _skf._validate(problem, k, n_shards)  # same errors as production
-        mesh = build_mesh(mesh_shape, devices[:n_shards])
-        nl = problem.N // n_shards
-        sharding = jax.sharding.NamedSharding(mesh, P("x"))
+        _skf._validate(problem, k, n_x, n_y)  # same errors as production
+        if not _skf._is_even(problem, k, n_x):
+            raise ValueError(
+                f"k-fused probe covers even decompositions "
+                f"(k | N/MX); got N={problem.N}, MX={n_x}, k={k}"
+            )
+        mesh = build_mesh(mesh_shape, devices[: n_x * n_y])
+        f = stencil_ref.compute_dtype(dtype)
+        _, _, syz, rsyz, _, _ = _kfused._oracle_parts(problem, f)
+        sharding = jax.sharding.NamedSharding(mesh, P("x", "y"))
         u_prev = jax.device_put(
             jnp.zeros((problem.N,) * 3, dtype), sharding
         )
         u = jax.device_put(jnp.zeros((problem.N,) * 3, dtype), sharding)
+        args = (u_prev, u, syz, rsyz)
         t_full = _time_best(
             _kfused_probe_runner(
-                problem, n_shards, mesh, dtype, k, interpret, True, iters
+                problem, (n_x, n_y), mesh, dtype, k, interpret, True,
+                iters,
             ),
-            (u_prev, u), repeats,
+            args, repeats,
         )
         t_comp = _time_best(
             _kfused_probe_runner(
-                problem, n_shards, mesh, dtype, k, interpret, False, iters
+                problem, (n_x, n_y), mesh, dtype, k, interpret, False,
+                iters,
             ),
-            (u_prev, u), repeats,
+            args, repeats,
         )
         scale = problem.timesteps / (iters * k)
         return PhaseBreakdown(
