@@ -230,6 +230,35 @@ def test_cli_compensated_kfused(tmp_path, capsys):
     assert side["run_config"]["v_dtype"] == "bf16"
 
 
+def test_cli_compensated_kfused_sharded(tmp_path, capsys):
+    """--scheme compensated --fuse-steps K --mesh MX,1,1 runs the
+    distributed velocity-form flagship, checkpoints per shard, and
+    resumes on the stored mesh."""
+    base = ["16", "1", "1", "1", "1", "1", "9"]
+    ck = str(tmp_path / "ck")
+    assert cli.main(
+        base + ["--scheme", "compensated", "--fuse-steps", "4",
+                "--mesh", "2,1,1", "--stop-step", "5",
+                "--save-state", ck, "--out-dir", str(tmp_path)]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "scheme: compensated" in out and "fuse-steps: 4" in out
+    res_dir = str(tmp_path / "res")
+    assert cli.main(
+        ["--resume", ck, "--fuse-steps", "4", "--out-dir", res_dir]
+    ) == 0
+    capsys.readouterr()
+    side = json.load(open(os.path.join(res_dir, "output_N16_Np2_TPU.json")))
+    assert side["run_config"]["scheme"] == "compensated"
+    assert side["run_config"]["mesh"] == [2, 1, 1]
+    # 2D meshes are rejected before compute.
+    assert cli.main(
+        base + ["--scheme", "compensated", "--fuse-steps", "4",
+                "--mesh", "2,2,1"]
+    ) == 2
+    capsys.readouterr()
+
+
 def test_cli_compensated_kfused_resume(tmp_path, capsys):
     """A compensated checkpoint resumes onto the k-fused path; stopping on
     a block-aligned layer keeps the remaining march's op sequence equal,

@@ -186,6 +186,87 @@ def test_errors_off(problem):
     assert np.all(res.abs_errors == 0.0)
 
 
+# ---------------------------------------------------------------------------
+# Sharded velocity-form k-fusion (the distributed flagship, x-only).
+# Cross-mesh agreement is ulp-level, not bitwise: sub-f32-ulp noise at the
+# representation-zero sx plane can flip rounding ties even with identical
+# per-plane op sequences (see stencil_pallas._kstep_comp_sharded_kernel).
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n_shards", [1, 2, 4])
+def test_sharded_matches_single_device(problem, ck4, n_shards):
+    got = kfused_comp.solve_kfused_comp_sharded(
+        problem, n_shards=n_shards, k=4, block_x=4, interpret=True
+    )
+    single = kfused_comp.solve_kfused_comp(
+        problem, k=4, block_x=4, interpret=True
+    )
+    diff = np.abs(
+        np.asarray(got.u_cur, np.float64)
+        - np.asarray(single.u_cur, np.float64)
+    ).max()
+    assert diff < 1e-6, diff
+    # The per-layer error rows assemble identically (measured: exact).
+    np.testing.assert_allclose(
+        got.abs_errors, single.abs_errors, rtol=1e-6, atol=1e-9
+    )
+    # And the accuracy stays at the compensated class vs the default-path
+    # result of the same scheme.
+    d2 = np.abs(
+        np.asarray(got.u_cur, np.float64) - np.asarray(ck4.u_cur, np.float64)
+    ).max()
+    assert d2 < 1e-6, d2
+
+
+def test_sharded_checkpoint_roundtrip(problem, tmp_path):
+    from wavetpu.io import checkpoint as ckpt
+
+    full = kfused_comp.solve_kfused_comp_sharded(
+        problem, n_shards=2, k=4, block_x=4, interpret=True
+    )
+    part = kfused_comp.solve_kfused_comp_sharded(
+        problem, n_shards=2, k=4, block_x=4, stop_step=13, interpret=True
+    )
+    path = str(tmp_path / "ck")
+    ckpt.save_sharded_checkpoint(path, part)
+    p2, u_prev, u_cur, step, mesh_shape, scheme, aux = (
+        ckpt.load_sharded_checkpoint(path)
+    )
+    assert scheme == "compensated" and step == 13
+    assert mesh_shape == (2, 1, 1)
+    v, c = aux
+    res = kfused_comp.resume_kfused_comp_sharded(
+        p2, np.asarray(u_cur), np.asarray(v), np.asarray(c), step,
+        n_shards=2, k=4, block_x=4, interpret=True,
+    )
+    # Block-aligned resume on the same mesh: identical op sequence.
+    np.testing.assert_array_equal(
+        np.asarray(res.u_cur), np.asarray(full.u_cur)
+    )
+
+
+def test_sharded_bf16_increment(problem, ref64):
+    got = kfused_comp.solve_kfused_comp_sharded(
+        problem, n_shards=4, k=4, v_dtype=jnp.bfloat16, carry=False,
+        interpret=True,
+    )
+    assert got.comp_v.dtype == jnp.bfloat16 and got.comp_carry is None
+    diff = np.abs(np.asarray(got.u_cur, np.float64) - ref64).max()
+    assert diff < 5e-3, diff
+
+
+def test_sharded_validation(problem):
+    with pytest.raises(ValueError, match="N % shards"):
+        kfused_comp.solve_kfused_comp_sharded(
+            problem, n_shards=3, k=4, interpret=True
+        )
+    with pytest.raises(ValueError, match="shard depth"):
+        kfused_comp.solve_kfused_comp_sharded(
+            problem, n_shards=8, k=8, interpret=True
+        )
+
+
 def test_validation(problem):
     with pytest.raises(ValueError, match="carrier"):
         kfused_comp.solve_kfused_comp(

@@ -32,9 +32,11 @@ pick at runtime):
                                     1.1e-3 L-inf at N=512/1000 on v5e);
                                     composes with --fuse-steps K into the
                                     FLAGSHIP velocity-form onion (~36
-                                    Gcell/s at 5.7e-6, single-device;
+                                    Gcell/s at 5.7e-6 single-device, and
+                                    sharded over --mesh MX,1,1 at K=2 for
+                                    N=512 - VMEM bounds K;
                                     solver/kfused_comp.py); f32/f64, 1-step
-                                    form also on the sharded backend
+                                    form also on any sharded mesh
                                     (checkpointable; no --overlap /
                                     --phase-timing)
   --v-dtype {f32,bf16}              increment-stream dtype for the
@@ -196,14 +198,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if fuse_steps > 1:
             if flags.get("kernel", "auto") == "roll":
                 raise ValueError("--fuse-steps needs the pallas kernel")
-            if scheme == "compensated" and (
-                "mesh" in flags or flags.get("backend") == "sharded"
-            ):
-                raise ValueError(
-                    "compensated k-fusion (--scheme compensated "
-                    "--fuse-steps) runs on the single-device backend; "
-                    "drop --mesh / --backend sharded"
-                )
+            if scheme == "compensated" and "mesh" in flags:
+                try:
+                    _mc = tuple(int(x) for x in flags["mesh"].split(","))
+                except ValueError:
+                    _mc = ()
+                if len(_mc) == 3 and _mc[1:] != (1, 1):
+                    raise ValueError(
+                        "compensated k-fusion shards along x only; use "
+                        f"--mesh MX,1,1 (got {flags['mesh']})"
+                    )
             if "mesh" in flags:
                 # k-fusion composes with (MX, MY, 1) decompositions; z is
                 # the lane dimension and stays whole
@@ -448,8 +452,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if not _even_x:
             if scheme == "compensated":
                 print(
-                    f"error: compensated k-fusion requires --fuse-steps "
-                    f"{fuse_steps} to divide N = {problem.N}",
+                    f"error: compensated k-fusion requires MX | N and "
+                    f"--fuse-steps {fuse_steps} | N/MX "
+                    f"(N={problem.N}, MX={_grid[0]})",
                     file=sys.stderr,
                 )
                 return 2
@@ -560,18 +565,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             # reporting its numbers against a compensated solve would
             # describe a program that never ran.
             bad = "--phase-timing"
-        elif fuse_steps > 1 and backend == "sharded":
-            # Covers `--resume sharded_comp_ck --fuse-steps K`: the
-            # velocity-form onion is single-device; the 1-step compensated
-            # sharded path remains available without --fuse-steps.
-            bad = "--fuse-steps on the sharded backend"
-        elif fuse_steps > 1 and problem.N % fuse_steps:
-            # Covers `--resume comp_ck --fuse-steps K` with K not
-            # dividing N: the scheme arrives from the checkpoint AFTER
+        elif fuse_steps > 1 and _grid[1] > 1:
+            # Covers `--resume sharded_comp_ck --fuse-steps K` on a 2D
+            # mesh: the velocity-form onion shards along x only.
+            bad = "--fuse-steps on a 2D mesh (use MX,1,1)"
+        elif fuse_steps > 1 and (
+            problem.N % _grid[0]
+            or (problem.N // _grid[0]) % fuse_steps
+        ):
+            # Covers `--resume comp_ck --fuse-steps K` with K (or MX)
+            # not dividing: the scheme arrives from the checkpoint AFTER
             # the flag-level divisibility check, which only sees
             # scheme == "standard" there.
             bad = (f"--fuse-steps {fuse_steps} (compensated k-fusion "
-                   f"requires it to divide N = {problem.N})")
+                   f"requires MX | N and K | N/MX; N={problem.N}, "
+                   f"MX={_grid[0]})")
         if bad:
             print(
                 f"error: {bad} is not available for the compensated "
@@ -611,7 +619,47 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             dtype if "dtype" in flags else jnp.dtype(_u_cur0.dtype)
         )
 
-    if backend == "sharded" and fuse_steps > 1:
+    if backend == "sharded" and fuse_steps > 1 and scheme == "compensated":
+        # Distributed velocity-form flagship ((MX, 1, 1) meshes).
+        from wavetpu.solver import kfused_comp
+
+        if resume_is_sharded:
+            _v, _c = _ck_aux
+            inc = (
+                jnp.dtype(_v.dtype) == jnp.bfloat16
+                and jnp.dtype(resume_dtype) != jnp.bfloat16
+            )
+            if inc:
+                flags["v-dtype"] = "bf16"
+            result = kfused_comp.resume_kfused_comp_sharded(
+                problem,
+                _u_cur0,
+                _v,
+                None if inc else _c,
+                start_step=_start,
+                n_shards=_ck_mesh[0],
+                dtype=resume_dtype,
+                k=fuse_steps,
+                compute_errors=compute_errors,
+                v_dtype=jnp.bfloat16 if inc else None,
+            )
+            shape = _ck_mesh
+        else:
+            shape = mesh_shape or (n_devices, 1, 1)
+            v_bf16 = flags.get("v-dtype") == "bf16"
+            result = kfused_comp.solve_kfused_comp_sharded(
+                problem,
+                n_shards=shape[0],
+                dtype=dtype,
+                k=fuse_steps,
+                compute_errors=compute_errors,
+                stop_step=stop_step,
+                v_dtype=jnp.bfloat16 if v_bf16 else None,
+                carry=not v_bf16,
+            )
+        n_procs = shape[0] * shape[1] * shape[2]
+        variant = "TPU"
+    elif backend == "sharded" and fuse_steps > 1:
         from wavetpu.solver import sharded_kfused
 
         if resume_is_sharded:

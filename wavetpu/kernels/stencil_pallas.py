@@ -821,7 +821,8 @@ def fused_kstep(u_prev, u, syz, rsyz, sxct, *, k, coeff, inv_h2,
 
 def choose_kstep_comp_block(
     n: int, k: int, u_itemsize: int = 4, v_itemsize: int = 4,
-    carry_itemsize: Optional[int] = 4,
+    carry_itemsize: Optional[int] = 4, depth: Optional[int] = None,
+    ghosts: bool = False,
 ) -> Optional[int]:
     """Slab depth for the compensated/velocity-form k-step kernel.
 
@@ -835,19 +836,31 @@ def choose_kstep_comp_block(
     latter is why bx=8 must be rejected there).  The carry-less
     coefficient carries an extra safety margin (3.4) because its
     rejection boundary was measured, not its acceptance.
+
+    `depth` is the x extent being blocked (the shard depth for the
+    sharded variant, default n); `ghosts=True` adds the sharded
+    variant's 4 k-plane ghost buffers (u/v lo+hi; measured cost on v5e
+    at N=512 k=4 bx=4: +20.9 MB over the ghost-less 127.72, i.e.
+    ~1.25x the naive 2*k*state estimate - Mosaic double-buffers part of
+    the constant-index fetches).  At N=512 that correctly rejects k=4
+    for the sharded comp kernel (148.6 MB measured > 128); k=2 fits.
     """
+    if depth is None:
+        depth = n
     plane_elems = n * n
     pb_f32 = plane_elems * 4
     state = u_itemsize + v_itemsize
     has_carry = carry_itemsize is not None
     best = None
     bx = k
-    while bx <= 8 and bx <= n:
-        if n % bx == 0:
+    while bx <= 8 and bx <= depth:
+        if depth % bx == 0:
             onion = bx + 2 * k
             pipeline = 2 * (onion + bx) * state * plane_elems
             if has_carry:
                 pipeline += 2 * 2 * bx * carry_itemsize * plane_elems
+            if ghosts:
+                pipeline += 5 * k * state * plane_elems // 2
             planes = 4 * pb_f32
             temps = (315 if has_carry else 340) * onion * pb_f32 // 100
             if pipeline + planes + temps <= _KSTEP_COMP_VMEM_LIMIT:
@@ -1017,6 +1030,193 @@ def fused_kstep_comp(u, v, carry, syz, rsyz, sxct, *, k, coeff, inv_h2,
     out = pl.pallas_call(
         kern,
         grid=(n // bx,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_KSTEP_COMP_VMEM_LIMIT
+        ),
+        interpret=interpret,
+    )(*operands)
+    u_o, v_o = out[0], out[1]
+    c_o = out[2] if has_carry else None
+    if with_errors:
+        return u_o, v_o, c_o, out[-2], out[-1]
+    return u_o, v_o, c_o, None, None
+
+
+def _kstep_comp_sharded_kernel(*refs, k, bx, coeff, inv_h2,
+                               compute_dtype, with_errors, has_carry):
+    """`_kstep_comp_kernel` for an x-sharded block: the k-plane u/v halos
+    of the block's EDGE programs come from ppermute'd ghost operands
+    instead of the in-block wraparound (the `pick` of
+    `_kstep_sharded_kernel`).  Carry stays slab-only with zero-seeded
+    halos - the same approximation as the single-device comp onion, so
+    for a shared block_x the per-plane op sequence is identical across
+    mesh shapes.  NO strict bitwise pin is claimed (unlike the standard
+    sharded onion): sub-f32-ulp value noise at the representation-zero
+    sx plane can flip rounding ties, so cross-mesh agreement is
+    ulp-level, pinned at tolerance with bitwise-equal error rows
+    (tests/test_kfused_comp.py) - within the scheme's tolerance-vs-f64
+    contract."""
+    it = iter(refs)
+    sxct_ref = next(it)
+    u_ref, ulo_ref, uhi_ref = next(it), next(it), next(it)
+    uglo_ref, ughi_ref = next(it), next(it)
+    v_ref, vlo_ref, vhi_ref = next(it), next(it), next(it)
+    vglo_ref, vghi_ref = next(it), next(it)
+    carry_ref = next(it) if has_carry else None
+    syz_ref, rsyz_ref = next(it), next(it)
+    out = list(it)
+    u_out, v_out = out[0], out[1]
+    carry_out = out[2] if has_carry else None
+    if with_errors:
+        dmax_ref, rmax_ref = out[-2], out[-1]
+
+    i = pl.program_id(0)
+    last = pl.num_programs(0) - 1
+    f = compute_dtype
+    ix, iy, iz = (jnp.asarray(val, f) for val in inv_h2)
+
+    def pick(edge_is_lo, ghost_ref, wrap_ref):
+        at_edge = (i == 0) if edge_is_lo else (i == last)
+        return jnp.where(
+            at_edge, ghost_ref[:].astype(f), wrap_ref[:].astype(f)
+        )
+
+    U = jnp.concatenate([
+        pick(True, uglo_ref, ulo_ref),
+        u_ref[:].astype(f),
+        pick(False, ughi_ref, uhi_ref),
+    ], 0)
+    V = jnp.concatenate([
+        pick(True, vglo_ref, vlo_ref),
+        v_ref[:].astype(f),
+        pick(False, vghi_ref, vhi_ref),
+    ], 0)
+    ny, nz = U.shape[1], U.shape[2]
+    if has_carry:
+        zpad = jnp.zeros((k, ny, nz), f)
+        C = jnp.concatenate([zpad, carry_ref[:].astype(f), zpad], 0)
+
+    ym = lax.broadcasted_iota(jnp.int32, (1, ny, nz), 1) != 0
+    zm = lax.broadcasted_iota(jnp.int32, (1, ny, nz), 2) != 0
+    mask = ym & zm
+    syz = syz_ref[:]
+    rsyz = rsyz_ref[:]
+
+    for s in range(1, k + 1):
+        uc = U[1:-1]
+        lap = (U[:-2] + U[2:] - 2.0 * uc) * ix
+        lap = lap + (
+            pltpu.roll(uc, 1, 1) + pltpu.roll(uc, ny - 1, 1) - 2.0 * uc
+        ) * iy
+        lap = lap + (
+            pltpu.roll(uc, 1, 2) + pltpu.roll(uc, nz - 1, 2) - 2.0 * uc
+        ) * iz
+        d = jnp.where(mask, jnp.asarray(coeff, f) * lap,
+                      jnp.asarray(0.0, f))
+        vn = V[1:-1] + d
+        if has_carry:
+            y = vn - C[1:-1]
+        else:
+            y = vn
+        t = uc + y
+        if has_carry:
+            C = (t - uc) - y
+        if with_errors:
+            ctr = t[k - s: k - s + bx]
+            for j in range(bx):
+                diff = jnp.abs(ctr[j] - sxct_ref[s - 1, i * bx + j] * syz)
+                dmax_ref[s - 1, i * bx + j] = jnp.max(diff).astype(
+                    jnp.float32)
+                rmax_ref[s - 1, i * bx + j] = jnp.max(diff * rsyz).astype(
+                    jnp.float32)
+        U, V = t, vn
+
+    u_out[:] = U.astype(u_out.dtype)
+    v_out[:] = V.astype(v_out.dtype)
+    if has_carry:
+        carry_out[:] = C.astype(carry_out.dtype)
+
+
+def fused_kstep_comp_sharded(u, v, carry, u_ghosts, v_ghosts, syz, rsyz,
+                             sxct, *, k, coeff, inv_h2, block_x=None,
+                             interpret=False, with_errors=True,
+                             compute_dtype=None):
+    """k fused compensated (velocity-form) leapfrog steps of one
+    x-sharded block - the distributed flagship scheme.
+
+    Must run inside `shard_map` on a (P, 1, 1) mesh.  `u`/`v`/`carry`
+    are local (N/P, N, N) blocks (carry=None for the carry-less
+    increment form); `u_ghosts`/`v_ghosts` are ((k, N, N) lo, hi) pairs
+    ppermute'd from the cyclic x-neighbours BEFORE the call, exactly as
+    `fused_kstep_sharded`.  `sxct` is this shard's (k, N/P) oracle row
+    slice.  Returns `(u', v', carry'|None, dmax, rmax)` with (k, N/P)
+    local error rows.
+    """
+    nl = u.shape[0]
+    if compute_dtype is None:
+        compute_dtype = stencil_ref.compute_dtype(u.dtype)
+    if nl % k:
+        raise ValueError(f"k={k} must divide the shard depth {nl}")
+    has_carry = carry is not None
+    bx = block_x or choose_kstep_comp_block(
+        u.shape[1], k, u.dtype.itemsize, v.dtype.itemsize,
+        carry.dtype.itemsize if has_carry else None,
+        depth=nl, ghosts=True,
+    )
+    if bx is None:
+        raise ValueError(
+            f"k={k} does not fit VMEM for {u.shape} shards "
+            f"(choose_kstep_comp_block)"
+        )
+    if nl % bx or bx % k:
+        raise ValueError(f"block_x={bx} must divide the shard depth {nl} "
+                         f"and be a multiple of k={k}")
+    ny, nz = u.shape[1], u.shape[2]
+    slab = pl.BlockSpec((bx, ny, nz), lambda i: (i, 0, 0),
+                        memory_space=pltpu.VMEM)
+    nb = nl // k
+    lo = pl.BlockSpec((k, ny, nz),
+                      lambda i, _bk=bx // k, _nb=nb:
+                      ((i * _bk - 1) % _nb, 0, 0),
+                      memory_space=pltpu.VMEM)
+    hi = pl.BlockSpec((k, ny, nz),
+                      lambda i, _bk=bx // k, _nb=nb:
+                      (((i + 1) * _bk) % _nb, 0, 0),
+                      memory_space=pltpu.VMEM)
+    ghost = pl.BlockSpec((k, ny, nz), lambda i: (0, 0, 0),
+                         memory_space=pltpu.VMEM)
+    plane = pl.BlockSpec((ny, nz), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM)
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    kern = functools.partial(
+        _kstep_comp_sharded_kernel, k=k, bx=bx, coeff=coeff,
+        inv_h2=inv_h2, compute_dtype=compute_dtype,
+        with_errors=with_errors, has_carry=has_carry,
+    )
+    in_specs = [smem, slab, lo, hi, ghost, ghost,
+                slab, lo, hi, ghost, ghost]
+    operands = [sxct, u, u, u, u_ghosts[0], u_ghosts[1],
+                v, v, v, v_ghosts[0], v_ghosts[1]]
+    if has_carry:
+        in_specs.append(slab)
+        operands.append(carry)
+    in_specs += [plane, plane]
+    operands += [syz, rsyz]
+    out_specs = [slab, slab]
+    out_shape = [_out_struct(u), _out_struct(v)]
+    if has_carry:
+        out_specs.append(slab)
+        out_shape.append(_out_struct(carry))
+    if with_errors:
+        err = _out_struct(u, shape=(k, nl), dtype=jnp.float32)
+        out_specs += [smem, smem]
+        out_shape += [err, err]
+    out = pl.pallas_call(
+        kern,
+        grid=(nl // bx,),
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,

@@ -74,6 +74,17 @@ def _oracle_parts(problem: Problem, f_dtype):
     return sx, ct, syz, rsyz, xmask, inv_absx
 
 
+def _layer_rows_local(u, sxct_row, syz_c, rsyz_c, f):
+    """(1, nl) per-x-plane abs/rel error maxes of one stored layer's local
+    block vs its oracle slice - the jnp bootstrap-layer counterpart of the
+    kernels' in-onion rows, shared by every sharded k-fused solver (a
+    change to this contract must not diverge between them)."""
+    diff = jnp.abs(u.astype(f) - sxct_row[:, None, None] * syz_c[None])
+    d = jnp.max(diff, axis=(1, 2))[None]
+    r = jnp.max(diff * rsyz_c[None], axis=(1, 2))[None]
+    return d, r
+
+
 def _block_errors(dmax, rmax, ctk, xmask, inv_absx):
     """(k,) abs / rel layer errors from the kernel's (k, N) plane maxes."""
     abs_e = jnp.max(jnp.where(xmask[None, :], dmax, 0.0), axis=1)

@@ -35,6 +35,13 @@ the 1-step scheme (intermediate layers skip the storage round-trip, halo
 carries differ); the contract is tolerance parity vs f64
 (tests/test_kfused_comp.py) and the remainder tail runs the SAME kernel
 at k=1, so stop/resume stays self-consistent.
+
+`solve_kfused_comp_sharded` distributes the scheme over (MX, 1, 1)
+meshes with k-deep ghost exchange per k layers (u and v ship; the carry
+stays shard-local, zero-seeded in halos exactly as on one device).  At
+N=512 the four full-plane ghost buffers bound k at 2 by VMEM (measured:
+k=4 wants 148.6 MB; k=2 runs 14.6 Gcell/s at 5.75e-6 on v5e vs 12.4 for
+the 1-step compensated sharded path).
 """
 
 from __future__ import annotations
@@ -269,6 +276,297 @@ def solve_kfused_comp(
     return _as_result(
         problem, out, init_s, solve_s, stop_step,
         stop_step if stop_step is not None else problem.timesteps,
+    )
+
+
+def _validate_sharded(problem: Problem, dtype, v_dtype, carry, k, n_x):
+    _validate(problem, dtype, v_dtype, carry, k)
+    if n_x < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_x}")
+    if problem.N % n_x:
+        raise ValueError(
+            f"sharded compensated k-fusion needs N % shards == 0 "
+            f"(N={problem.N}, shards={n_x})"
+        )
+    if (problem.N // n_x) % k:
+        raise ValueError(
+            f"k={k} must divide the shard depth {problem.N // n_x}"
+        )
+
+
+def _make_sharded_runner(problem, mesh, n_x, dtype, v_dtype, carry_on, k,
+                         compute_errors, nsteps, start_step, block_x,
+                         interpret):
+    """x-only sharded velocity-form runner: the distributed flagship.
+
+    One cyclic k-plane ppermute pair per field (u, v) per k-block; the
+    carry stays shard-local (its halos zero-seed exactly as on a single
+    device, so for a shared block_x results are BITWISE equal across
+    mesh shapes).  The bootstrap and the remainder tail run the same
+    kernel at k=1 (the bootstrap with coeff C/2 on zero v/carry, which
+    IS the compensated half-step).
+    """
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    f = stencil_ref.compute_dtype(dtype)
+    nl = problem.N // n_x
+    sx, ct, syz, rsyz, xmask, inv_absx = kfused._oracle_parts(problem, f)
+    inv_absx = jnp.where(jnp.abs(sx) > _rel_guard_tol(f), inv_absx,
+                         jnp.asarray(0.0, f))
+    sxct_all = ct[:, None] * sx[None, :]
+    perm_fwd = [(i, (i + 1) % n_x) for i in range(n_x)]
+    perm_bwd = [(i, (i - 1) % n_x) for i in range(n_x)]
+    start = 1 if start_step is None else start_step
+    nblocks = (nsteps - start) // k
+    rem = (nsteps - start) - nblocks * k
+    # One block_x for every kk so the op sequence matches the
+    # single-device kernel's block partitioning (bitwise contract).
+    bx = block_x or stencil_pallas.choose_kstep_comp_block(
+        problem.N, k, jnp.dtype(dtype).itemsize,
+        jnp.dtype(v_dtype).itemsize,
+        jnp.dtype(dtype).itemsize if carry_on else None,
+        depth=nl, ghosts=True,
+    )
+    if bx is None:
+        raise ValueError(
+            f"k={k} does not fit VMEM for N={problem.N} over {n_x} shards"
+        )
+
+    def ghosts(a, kk):
+        if n_x == 1:
+            return a[-kk:], a[:kk]
+        return (
+            lax.ppermute(a[-kk:], "x", perm_fwd),
+            lax.ppermute(a[:kk], "x", perm_bwd),
+        )
+
+    def kcall(syz_c, rsyz_c, u, v, c, sxct_k, kk, coeff, with_err):
+        return stencil_pallas.fused_kstep_comp_sharded(
+            u, v, c, ghosts(u, kk), ghosts(v, kk), syz_c, rsyz_c,
+            sxct_k, k=kk, coeff=coeff, inv_h2=problem.inv_h2,
+            block_x=bx, interpret=interpret, with_errors=with_err,
+        )
+
+    def layer_rows(syz_c, rsyz_c, u, sxct_row):
+        return kfused._layer_rows_local(u, sxct_row, syz_c, rsyz_c, f)
+
+    def local_march(syz_c, rsyz_c, u, v, c, sxct_loc, first):
+        rows_d, rows_r = [], []
+
+        def body(state, nstart):
+            u, v, c = state
+            sxct_k = lax.dynamic_slice(sxct_loc, (nstart + 1, 0), (k, nl))
+            u2, v2, c2, dm, rm = kcall(
+                syz_c, rsyz_c, u, v, c, sxct_k, k, problem.a2tau2,
+                compute_errors,
+            )
+            if not compute_errors:
+                dm = rm = jnp.zeros((k, nl), f)
+            return (u2, v2, c2), (dm, rm)
+
+        starts = first + k * jnp.arange(nblocks)
+        (u, v, c), (dmb, rmb) = lax.scan(body, (u, v, c), starts)
+        rows_d.append(dmb.reshape(-1, nl))
+        rows_r.append(rmb.reshape(-1, nl))
+        for t in range(rem):
+            layer = nsteps - rem + 1 + t
+            sxct_1 = lax.dynamic_slice(sxct_loc, (layer, 0), (1, nl))
+            u, v, c, dm, rm = kcall(
+                syz_c, rsyz_c, u, v, c, sxct_1, 1, problem.a2tau2,
+                compute_errors,
+            )
+            if not compute_errors:
+                dm = rm = jnp.zeros((1, nl), f)
+            rows_d.append(dm)
+            rows_r.append(rm)
+        return u, v, c, jnp.concatenate(rows_d), jnp.concatenate(rows_r)
+
+    def assemble(dmax, rmax):
+        if not compute_errors:
+            z = jnp.zeros((nsteps + 1,), f)
+            return z, z
+        return kfused._block_errors(
+            dmax, rmax, ct[: dmax.shape[0]], xmask, inv_absx
+        )
+
+    state_spec = P("x")
+    rows_spec = P(None, "x")
+    plane_spec = P(None, None)
+
+    if start_step is None:
+
+        def local(u0, sxct_loc, syz_c, rsyz_c):
+            zero_v = jnp.zeros(u0.shape, v_dtype)
+            zero_c = jnp.zeros(u0.shape, dtype) if carry_on else None
+            u1, v1, c1, _, _ = kcall(
+                syz_c, rsyz_c, u0, zero_v, zero_c,
+                jnp.zeros((1, nl), f), 1, 0.5 * problem.a2tau2, False,
+            )
+            if compute_errors:
+                d1, r1 = layer_rows(syz_c, rsyz_c, u1, sxct_loc[1])
+            else:
+                d1 = r1 = jnp.zeros((1, nl), f)
+            u, v, c, rows_d, rows_r = local_march(
+                syz_c, rsyz_c, u1, v1, c1, sxct_loc, 1
+            )
+            zero = jnp.zeros((1, nl), f)
+            return (
+                u, v, c,
+                jnp.concatenate([zero, d1, rows_d]),
+                jnp.concatenate([zero, r1, rows_r]),
+            )
+
+        local_fn = jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(state_spec, rows_spec, plane_spec, plane_spec),
+            out_specs=(state_spec, state_spec,
+                       state_spec if carry_on else None,
+                       rows_spec, rows_spec),
+            check_vma=False,
+        )
+
+        def run():
+            u0 = lax.with_sharding_constraint(
+                leapfrog.initial_layer0(problem, dtype),
+                NamedSharding(mesh, state_spec),
+            )
+            u, v, c, dmax, rmax = local_fn(u0, sxct_all, syz, rsyz)
+            abs_e, rel_e = assemble(dmax, rmax)
+            return u, v, c, abs_e, rel_e
+
+        return jax.jit(run)
+
+    def local_resume(u, v, c, sxct_loc, syz_c, rsyz_c):
+        u, v, c, rows_d, rows_r = local_march(
+            syz_c, rsyz_c, u, v, c, sxct_loc, start_step
+        )
+        head = jnp.zeros((start_step + 1, nl), f)
+        return (
+            u, v, c,
+            jnp.concatenate([head, rows_d]),
+            jnp.concatenate([head, rows_r]),
+        )
+
+    local_fn = jax.shard_map(
+        local_resume, mesh=mesh,
+        in_specs=(state_spec, state_spec,
+                  state_spec if carry_on else None,
+                  rows_spec, plane_spec, plane_spec),
+        out_specs=(state_spec, state_spec,
+                   state_spec if carry_on else None,
+                   rows_spec, rows_spec),
+        check_vma=False,
+    )
+
+    def run(u, v, c):
+        u, v, c, dmax, rmax = local_fn(u, v, c, sxct_all, syz, rsyz)
+        abs_e, rel_e = assemble(dmax, rmax)
+        return u, v, c, abs_e, rel_e
+
+    return jax.jit(run)
+
+
+def solve_kfused_comp_sharded(
+    problem: Problem,
+    n_shards: Optional[int] = None,
+    dtype=jnp.float32,
+    k: int = 4,
+    compute_errors: bool = True,
+    stop_step: Optional[int] = None,
+    block_x: Optional[int] = None,
+    interpret: Optional[bool] = None,
+    devices=None,
+    v_dtype=None,
+    carry: bool = True,
+) -> leapfrog.SolveResult:
+    """Distributed velocity-form compensated k-fused solve over a
+    (P, 1, 1) mesh - the flagship scheme at the reference's distributed
+    scale (mpi_new.cpp's role), with the compensated accuracy contract.
+    Requires P | N and k | N/P."""
+    from wavetpu.core.grid import build_mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if n_shards is None:
+        n_shards = len(devices)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    v_dtype = dtype if v_dtype is None else jnp.dtype(v_dtype)
+    _validate_sharded(problem, dtype, v_dtype, carry, k, n_shards)
+    nsteps = problem.timesteps if stop_step is None else stop_step
+    if not 1 <= nsteps <= problem.timesteps:
+        raise ValueError(
+            f"stop_step must be in [1, {problem.timesteps}], got {nsteps}"
+        )
+    mesh = build_mesh((n_shards, 1, 1), devices[:n_shards])
+    runner = _make_sharded_runner(
+        problem, mesh, n_shards, dtype, v_dtype, carry, k,
+        compute_errors, nsteps, None, block_x, interpret,
+    )
+    out, init_s, solve_s = leapfrog._timed_compile_run(
+        runner, (), sync=lambda o: np.asarray(o[3])
+    )
+    return _as_result(
+        problem, out, init_s, solve_s, stop_step,
+        stop_step if stop_step is not None else problem.timesteps,
+    )
+
+
+def resume_kfused_comp_sharded(
+    problem: Problem,
+    u_cur,
+    v,
+    carry,
+    start_step: int,
+    n_shards: Optional[int] = None,
+    dtype=jnp.float32,
+    k: int = 4,
+    compute_errors: bool = True,
+    block_x: Optional[int] = None,
+    interpret: Optional[bool] = None,
+    devices=None,
+    v_dtype=None,
+) -> leapfrog.SolveResult:
+    """Re-enter the sharded velocity-form march at layer `start_step`
+    from compensated checkpoint state (carry=None resumes the carry-less
+    increment form)."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from wavetpu.core.grid import build_mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if n_shards is None:
+        n_shards = len(devices)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    v_dtype = dtype if v_dtype is None else jnp.dtype(v_dtype)
+    carry_on = carry is not None
+    _validate_sharded(problem, dtype, v_dtype, carry_on, k, n_shards)
+    nsteps = problem.timesteps
+    if not 1 <= start_step <= nsteps:
+        raise ValueError(
+            f"start_step must be in [1, {nsteps}], got {start_step}"
+        )
+    mesh = build_mesh((n_shards, 1, 1), devices[:n_shards])
+    runner = _make_sharded_runner(
+        problem, mesh, n_shards, dtype, v_dtype, carry_on, k,
+        compute_errors, nsteps, start_step, block_x, interpret,
+    )
+    sharding = NamedSharding(mesh, P("x"))
+    args = (
+        jax.device_put(jnp.asarray(u_cur, dtype), sharding),
+        jax.device_put(jnp.asarray(v, v_dtype), sharding),
+        jax.device_put(jnp.asarray(carry, dtype), sharding)
+        if carry_on else None,
+    )
+    out, init_s, solve_s = leapfrog._timed_compile_run(
+        runner, args, sync=lambda o: np.asarray(o[3])
+    )
+    return _as_result(
+        problem, out, init_s, solve_s, nsteps - start_step, nsteps
     )
 
 

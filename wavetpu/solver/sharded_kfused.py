@@ -208,12 +208,9 @@ def _make_runner(
         return up, uc, dm, rm
 
     def layer_rows(syz_c, rsyz_c, u, sxct_row):
-        """(1, nl) plane-max rows of a stored layer (jnp path, used for
-        the bootstrap layer only); max over this shard's y slice, pmax'd
-        across the y mesh axis."""
-        diff = jnp.abs(u.astype(f) - sxct_row[:, None, None] * syz_c[None])
-        d = jnp.max(diff, axis=(1, 2))[None]
-        r = jnp.max(diff * rsyz_c[None], axis=(1, 2))[None]
+        """Bootstrap-layer rows (kfused._layer_rows_local), pmax'd across
+        the y mesh axis on 2D meshes."""
+        d, r = kfused._layer_rows_local(u, sxct_row, syz_c, rsyz_c, f)
         if n_y > 1:
             d = lax.pmax(d, "y")
             r = lax.pmax(r, "y")
@@ -460,12 +457,7 @@ def _make_padded_runner(
         )
 
     def layer_rows(syz_c, rsyz_c, u, sxct_row):
-        diff = jnp.abs(
-            u.astype(f) - sxct_row[:, None, None] * syz_c[None]
-        )
-        dd = jnp.max(diff, axis=(1, 2))[None]
-        rr = jnp.max(diff * rsyz_c[None], axis=(1, 2))[None]
-        return dd, rr
+        return kfused._layer_rows_local(u, sxct_row, syz_c, rsyz_c, f)
 
     def local_march(syz_c, rsyz_c, u_prev, u, sxct_loc, first):
         rows_d, rows_r = [], []
