@@ -251,12 +251,16 @@ def test_cli_compensated_kfused_sharded(tmp_path, capsys):
     side = json.load(open(os.path.join(res_dir, "output_N16_Np2_TPU.json")))
     assert side["run_config"]["scheme"] == "compensated"
     assert side["run_config"]["mesh"] == [2, 1, 1]
-    # 2D meshes are rejected before compute.
+    # 2D meshes run the xy velocity-form kernel (round-5).
     assert cli.main(
         base + ["--scheme", "compensated", "--fuse-steps", "4",
-                "--mesh", "2,2,1"]
-    ) == 2
+                "--mesh", "2,2,1", "--out-dir", str(tmp_path / "xy")]
+    ) == 0
     capsys.readouterr()
+    side = json.load(
+        open(os.path.join(str(tmp_path / "xy"), "output_N16_Np4_TPU.json"))
+    )
+    assert side["run_config"]["mesh"] == [2, 2, 1]
 
 
 def test_cli_compensated_kfused_resume(tmp_path, capsys):

@@ -256,6 +256,56 @@ def test_sharded_bf16_increment(problem, ref64):
     assert diff < 5e-3, diff
 
 
+@pytest.mark.parametrize("mesh", [(2, 2, 1), (1, 2, 1), (2, 4, 1)])
+def test_sharded_xy_matches_single_device(problem, mesh):
+    """2D-mesh velocity-form k-fusion (y-extended blocks, wrapped-global-y
+    increment mask, corners via sequenced exchange) agrees with the
+    single-device solve at ulp level; y-sharding is what lifts the VMEM
+    bound on k (Mosaic-validated on chip at N=512 k=4 nl_y=64)."""
+    single = kfused_comp.solve_kfused_comp(
+        problem, k=4, block_x=4, interpret=True
+    )
+    got = kfused_comp.solve_kfused_comp_sharded(
+        problem, mesh_shape=mesh, k=4, block_x=4, interpret=True
+    )
+    diff = np.abs(
+        np.asarray(got.u_cur, np.float64)
+        - np.asarray(single.u_cur, np.float64)
+    ).max()
+    assert diff < 1e-6, diff
+    # Error rows are maxima over slightly (ulp-level) different fields:
+    # a few e-7 absolute play at the 1e-3 error scale is expected.
+    np.testing.assert_allclose(
+        got.abs_errors, single.abs_errors, rtol=1e-3, atol=1e-7
+    )
+
+
+def test_sharded_xy_checkpoint_roundtrip(problem, tmp_path):
+    from wavetpu.io import checkpoint as ckpt
+
+    full = kfused_comp.solve_kfused_comp_sharded(
+        problem, mesh_shape=(2, 2, 1), k=4, block_x=4, interpret=True
+    )
+    part = kfused_comp.solve_kfused_comp_sharded(
+        problem, mesh_shape=(2, 2, 1), k=4, block_x=4, stop_step=13,
+        interpret=True,
+    )
+    path = str(tmp_path / "ck")
+    ckpt.save_sharded_checkpoint(path, part)
+    p2, u_prev, u_cur, step, mesh_shape, scheme, aux = (
+        ckpt.load_sharded_checkpoint(path)
+    )
+    assert scheme == "compensated" and mesh_shape == (2, 2, 1)
+    v, c = aux
+    res = kfused_comp.resume_kfused_comp_sharded(
+        p2, np.asarray(u_cur), np.asarray(v), np.asarray(c), step,
+        mesh_shape=(2, 2, 1), k=4, block_x=4, interpret=True,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res.u_cur), np.asarray(full.u_cur)
+    )
+
+
 def test_sharded_validation(problem):
     with pytest.raises(ValueError, match="N % shards"):
         kfused_comp.solve_kfused_comp_sharded(
@@ -264,6 +314,15 @@ def test_sharded_validation(problem):
     with pytest.raises(ValueError, match="shard depth"):
         kfused_comp.solve_kfused_comp_sharded(
             problem, n_shards=8, k=8, interpret=True
+        )
+    with pytest.raises(ValueError, match="y shard depth"):
+        # nl_y = 2 < k = 4 (validation precedes mesh construction).
+        kfused_comp.solve_kfused_comp_sharded(
+            problem, mesh_shape=(1, 16, 1), k=4, interpret=True
+        )
+    with pytest.raises(ValueError, match=r"\(MX, MY, 1\)"):
+        kfused_comp.solve_kfused_comp_sharded(
+            problem, mesh_shape=(2, 1, 2), k=4, interpret=True
         )
 
 

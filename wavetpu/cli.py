@@ -198,16 +198,6 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if fuse_steps > 1:
             if flags.get("kernel", "auto") == "roll":
                 raise ValueError("--fuse-steps needs the pallas kernel")
-            if scheme == "compensated" and "mesh" in flags:
-                try:
-                    _mc = tuple(int(x) for x in flags["mesh"].split(","))
-                except ValueError:
-                    _mc = ()
-                if len(_mc) == 3 and _mc[1:] != (1, 1):
-                    raise ValueError(
-                        "compensated k-fusion shards along x only; use "
-                        f"--mesh MX,1,1 (got {flags['mesh']})"
-                    )
             if "mesh" in flags:
                 # k-fusion composes with (MX, MY, 1) decompositions; z is
                 # the lane dimension and stays whole
@@ -565,10 +555,6 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             # reporting its numbers against a compensated solve would
             # describe a program that never ran.
             bad = "--phase-timing"
-        elif fuse_steps > 1 and _grid[1] > 1:
-            # Covers `--resume sharded_comp_ck --fuse-steps K` on a 2D
-            # mesh: the velocity-form onion shards along x only.
-            bad = "--fuse-steps on a 2D mesh (use MX,1,1)"
         elif fuse_steps > 1 and (
             problem.N % _grid[0]
             or (problem.N // _grid[0]) % fuse_steps
@@ -637,7 +623,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 _v,
                 None if inc else _c,
                 start_step=_start,
-                n_shards=_ck_mesh[0],
+                mesh_shape=_ck_mesh,
                 dtype=resume_dtype,
                 k=fuse_steps,
                 compute_errors=compute_errors,
@@ -649,7 +635,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             v_bf16 = flags.get("v-dtype") == "bf16"
             result = kfused_comp.solve_kfused_comp_sharded(
                 problem,
-                n_shards=shape[0],
+                mesh_shape=shape,
                 dtype=dtype,
                 k=fuse_steps,
                 compute_errors=compute_errors,

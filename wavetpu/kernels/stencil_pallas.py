@@ -822,7 +822,7 @@ def fused_kstep(u_prev, u, syz, rsyz, sxct, *, k, coeff, inv_h2,
 def choose_kstep_comp_block(
     n: int, k: int, u_itemsize: int = 4, v_itemsize: int = 4,
     carry_itemsize: Optional[int] = 4, depth: Optional[int] = None,
-    ghosts: bool = False,
+    ghosts: bool = False, plane_elems: Optional[int] = None,
 ) -> Optional[int]:
     """Slab depth for the compensated/velocity-form k-step kernel.
 
@@ -847,7 +847,8 @@ def choose_kstep_comp_block(
     """
     if depth is None:
         depth = n
-    plane_elems = n * n
+    if plane_elems is None:
+        plane_elems = n * n
     pb_f32 = plane_elems * 4
     state = u_itemsize + v_itemsize
     has_carry = carry_itemsize is not None
@@ -1217,6 +1218,214 @@ def fused_kstep_comp_sharded(u, v, carry, u_ghosts, v_ghosts, syz, rsyz,
     out = pl.pallas_call(
         kern,
         grid=(nl // bx,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_KSTEP_COMP_VMEM_LIMIT
+        ),
+        interpret=interpret,
+    )(*operands)
+    u_o, v_o = out[0], out[1]
+    c_o = out[2] if has_carry else None
+    if with_errors:
+        return u_o, v_o, c_o, out[-2], out[-1]
+    return u_o, v_o, c_o, None, None
+
+
+def _kstep_comp_sharded_xy_kernel(*refs, k, bx, nl_y, n_global, coeff,
+                                  inv_h2, compute_dtype, with_errors,
+                                  has_carry):
+    """`_kstep_comp_sharded_kernel` for blocks ALSO sharded along y.
+
+    u and v arrive pre-extended with k ghost ROWS per side (width
+    W = nl_y + 2k) and their x ghosts are ppermute'd FROM the extended
+    blocks (corner data rides the sequencing, as in
+    `_kstep_sharded_xy_kernel`); the carry stays central (nl_y rows),
+    zero-seeded in both the x halo planes and the y ghost rows.  The
+    increment mask tests the WRAPPED global row index ((y0 - k + row)
+    mod N != 0) so evolved ghost copies of the global y=0 stored zero
+    plane never leak nonzero increments.  Outputs and error rows slice
+    the central y rows (callers pmax rows over the y mesh axis).
+    """
+    it = iter(refs)
+    y0_ref = next(it)
+    sxct_ref = next(it)
+    u_ref, ulo_ref, uhi_ref = next(it), next(it), next(it)
+    uglo_ref, ughi_ref = next(it), next(it)
+    v_ref, vlo_ref, vhi_ref = next(it), next(it), next(it)
+    vglo_ref, vghi_ref = next(it), next(it)
+    carry_ref = next(it) if has_carry else None
+    syzc_ref, rsyzc_ref = next(it), next(it)
+    out = list(it)
+    u_out, v_out = out[0], out[1]
+    carry_out = out[2] if has_carry else None
+    if with_errors:
+        dmax_ref, rmax_ref = out[-2], out[-1]
+
+    i = pl.program_id(0)
+    last = pl.num_programs(0) - 1
+    f = compute_dtype
+    ix, iy, iz = (jnp.asarray(val, f) for val in inv_h2)
+
+    def pick(edge_is_lo, ghost_ref, wrap_ref):
+        at_edge = (i == 0) if edge_is_lo else (i == last)
+        return jnp.where(
+            at_edge, ghost_ref[:].astype(f), wrap_ref[:].astype(f)
+        )
+
+    U = jnp.concatenate([
+        pick(True, uglo_ref, ulo_ref),
+        u_ref[:].astype(f),
+        pick(False, ughi_ref, uhi_ref),
+    ], 0)
+    V = jnp.concatenate([
+        pick(True, vglo_ref, vlo_ref),
+        v_ref[:].astype(f),
+        pick(False, vghi_ref, vhi_ref),
+    ], 0)
+    w, nz = U.shape[1], U.shape[2]
+    if has_carry:
+        cpad_x = jnp.zeros((k, w, nz), f)
+        cc = carry_ref[:].astype(f)
+        cpad_y = jnp.zeros((cc.shape[0], k, nz), f)
+        C = jnp.concatenate([
+            cpad_x,
+            jnp.concatenate([cpad_y, cc, cpad_y], 1),
+            cpad_x,
+        ], 0)
+
+    gy = (y0_ref[0] - k + lax.broadcasted_iota(jnp.int32, (1, w, nz), 1))
+    gy = gy % n_global
+    zm = lax.broadcasted_iota(jnp.int32, (1, w, nz), 2) != 0
+    mask = (gy != 0) & zm
+
+    for s in range(1, k + 1):
+        uc = U[1:-1]
+        lap = (U[:-2] + U[2:] - 2.0 * uc) * ix
+        lap = lap + (
+            pltpu.roll(uc, 1, 1) + pltpu.roll(uc, w - 1, 1) - 2.0 * uc
+        ) * iy
+        lap = lap + (
+            pltpu.roll(uc, 1, 2) + pltpu.roll(uc, nz - 1, 2) - 2.0 * uc
+        ) * iz
+        d = jnp.where(mask, jnp.asarray(coeff, f) * lap,
+                      jnp.asarray(0.0, f))
+        vn = V[1:-1] + d
+        if has_carry:
+            y = vn - C[1:-1]
+        else:
+            y = vn
+        t = uc + y
+        if has_carry:
+            C = (t - uc) - y
+        if with_errors:
+            ctr = t[k - s: k - s + bx, k: k + nl_y]
+            syz = syzc_ref[:]
+            rsyz = rsyzc_ref[:]
+            for j in range(bx):
+                diff = jnp.abs(ctr[j] - sxct_ref[s - 1, i * bx + j] * syz)
+                dmax_ref[s - 1, i * bx + j] = jnp.max(diff).astype(
+                    jnp.float32)
+                rmax_ref[s - 1, i * bx + j] = jnp.max(diff * rsyz).astype(
+                    jnp.float32)
+        U, V = t, vn
+
+    u_out[:] = U[:, k: k + nl_y].astype(u_out.dtype)
+    v_out[:] = V[:, k: k + nl_y].astype(v_out.dtype)
+    if has_carry:
+        carry_out[:] = C[:, k: k + nl_y].astype(carry_out.dtype)
+
+
+def fused_kstep_comp_sharded_xy(u_ext, v_ext, carry, u_ghosts, v_ghosts,
+                                syz_c, rsyz_c, sxct, y0, n_global, *,
+                                k, nl_y, coeff, inv_h2, block_x=None,
+                                interpret=False, with_errors=True,
+                                compute_dtype=None):
+    """k fused compensated (velocity-form) steps of an (x, y)-sharded
+    block - the distributed flagship on 2D meshes.
+
+    Must run inside `shard_map` on a (P, Q, 1) mesh.  `u_ext`/`v_ext`
+    are local blocks pre-extended with k ghost rows per y side;
+    `carry` is the CENTRAL (nl_x, nl_y, nz) block (or None for the
+    increment form); `u_ghosts`/`v_ghosts` are ((k, W, nz) lo, hi)
+    x-ghost pairs ppermute'd from the extended blocks.  Returns central
+    (nl_x, nl_y, nz) state + (k, nl_x) error rows (max over this
+    shard's y range; callers pmax over the y axis).  y-sharding shrinks
+    every VMEM plane by Q, which is what lets k=4 fit at N=512 where
+    the x-only variant is VMEM-bound at k=2.
+    """
+    nl_x, w, nz = u_ext.shape
+    if compute_dtype is None:
+        compute_dtype = stencil_ref.compute_dtype(u_ext.dtype)
+    if w != nl_y + 2 * k:
+        raise ValueError(
+            f"extended y width {w} != nl_y + 2k = {nl_y + 2 * k}"
+        )
+    if nl_x % k:
+        raise ValueError(f"k={k} must divide the shard depth {nl_x}")
+    has_carry = carry is not None
+    bx = block_x or choose_kstep_comp_block(
+        nz, k, u_ext.dtype.itemsize, v_ext.dtype.itemsize,
+        carry.dtype.itemsize if has_carry else None,
+        depth=nl_x, ghosts=True, plane_elems=w * nz,
+    )
+    if bx is None:
+        raise ValueError(
+            f"k={k} does not fit VMEM for {u_ext.shape} blocks"
+        )
+    if nl_x % bx or bx % k:
+        raise ValueError(f"block_x={bx} must divide the shard depth "
+                         f"{nl_x} and be a multiple of k={k}")
+    slab = pl.BlockSpec((bx, w, nz), lambda i: (i, 0, 0),
+                        memory_space=pltpu.VMEM)
+    nb = nl_x // k
+    lo = pl.BlockSpec((k, w, nz),
+                      lambda i, _bk=bx // k, _nb=nb:
+                      ((i * _bk - 1) % _nb, 0, 0),
+                      memory_space=pltpu.VMEM)
+    hi = pl.BlockSpec((k, w, nz),
+                      lambda i, _bk=bx // k, _nb=nb:
+                      (((i + 1) * _bk) % _nb, 0, 0),
+                      memory_space=pltpu.VMEM)
+    ghost = pl.BlockSpec((k, w, nz), lambda i: (0, 0, 0),
+                         memory_space=pltpu.VMEM)
+    cslab = pl.BlockSpec((bx, nl_y, nz), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM)
+    plane = pl.BlockSpec((nl_y, nz), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM)
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    kern = functools.partial(
+        _kstep_comp_sharded_xy_kernel, k=k, bx=bx, nl_y=nl_y,
+        n_global=n_global, coeff=coeff, inv_h2=inv_h2,
+        compute_dtype=compute_dtype, with_errors=with_errors,
+        has_carry=has_carry,
+    )
+    in_specs = [smem, smem, slab, lo, hi, ghost, ghost,
+                slab, lo, hi, ghost, ghost]
+    operands = [jnp.asarray(y0, jnp.int32).reshape(1), sxct,
+                u_ext, u_ext, u_ext, u_ghosts[0], u_ghosts[1],
+                v_ext, v_ext, v_ext, v_ghosts[0], v_ghosts[1]]
+    if has_carry:
+        in_specs.append(cslab)
+        operands.append(carry)
+    in_specs += [plane, plane]
+    operands += [syz_c, rsyz_c]
+    state = _out_struct(u_ext, shape=(nl_x, nl_y, nz))
+    vstate = _out_struct(v_ext, shape=(nl_x, nl_y, nz),
+                         dtype=v_ext.dtype)
+    out_specs = [cslab, cslab]
+    out_shape = [state, vstate]
+    if has_carry:
+        out_specs.append(cslab)
+        out_shape.append(_out_struct(carry))
+    if with_errors:
+        err = _out_struct(u_ext, shape=(k, nl_x), dtype=jnp.float32)
+        out_specs += [smem, smem]
+        out_shape += [err, err]
+    out = pl.pallas_call(
+        kern,
+        grid=(nl_x // bx,),
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,

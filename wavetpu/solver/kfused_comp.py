@@ -36,12 +36,15 @@ carries differ); the contract is tolerance parity vs f64
 (tests/test_kfused_comp.py) and the remainder tail runs the SAME kernel
 at k=1, so stop/resume stays self-consistent.
 
-`solve_kfused_comp_sharded` distributes the scheme over (MX, 1, 1)
-meshes with k-deep ghost exchange per k layers (u and v ship; the carry
-stays shard-local, zero-seeded in halos exactly as on one device).  At
-N=512 the four full-plane ghost buffers bound k at 2 by VMEM (measured:
-k=4 wants 148.6 MB; k=2 runs 14.6 Gcell/s at 5.75e-6 on v5e vs 12.4 for
-the 1-step compensated sharded path).
+`solve_kfused_comp_sharded` distributes the scheme over (MX, MY, 1)
+meshes with k-deep ghost exchange per k layers per axis (u and v ship;
+the carry stays shard-local, zero-seeded in halos exactly as on one
+device; on 2D meshes the y-row extension ships first and the x ghosts
+ride the extended blocks, corner data via the sequencing).  x-only at
+N=512 is VMEM-bound to k=2 (the four full-plane ghost buffers push k=4
+to a measured 148.6 MB; k=2 runs 14.6 Gcell/s at 5.75e-6 on v5e vs 12.4
+for the 1-step compensated sharded path); y-sharding shrinks every VMEM
+plane by MY and restores k=4 (Mosaic-validated on chip at nl_y=64).
 """
 
 from __future__ import annotations
@@ -279,10 +282,13 @@ def solve_kfused_comp(
     )
 
 
-def _validate_sharded(problem: Problem, dtype, v_dtype, carry, k, n_x):
+def _validate_sharded(problem: Problem, dtype, v_dtype, carry, k, n_x,
+                      n_y: int = 1):
     _validate(problem, dtype, v_dtype, carry, k)
-    if n_x < 1:
-        raise ValueError(f"n_shards must be >= 1, got {n_x}")
+    if n_x < 1 or n_y < 1:
+        raise ValueError(
+            f"mesh axes must be >= 1 (got MX={n_x}, MY={n_y})"
+        )
     if problem.N % n_x:
         raise ValueError(
             f"sharded compensated k-fusion needs N % shards == 0 "
@@ -292,45 +298,71 @@ def _validate_sharded(problem: Problem, dtype, v_dtype, carry, k, n_x):
         raise ValueError(
             f"k={k} must divide the shard depth {problem.N // n_x}"
         )
+    if problem.N % n_y:
+        raise ValueError(
+            f"y-sharded compensated k-fusion needs N % y-shards == 0 "
+            f"(N={problem.N}, y-shards={n_y})"
+        )
+    if problem.N // n_y < k:
+        raise ValueError(
+            f"k={k} exceeds the y shard depth {problem.N // n_y}"
+        )
 
 
-def _make_sharded_runner(problem, mesh, n_x, dtype, v_dtype, carry_on, k,
+def _make_sharded_runner(problem, mesh, grid, dtype, v_dtype, carry_on, k,
                          compute_errors, nsteps, start_step, block_x,
                          interpret):
-    """x-only sharded velocity-form runner: the distributed flagship.
+    """Sharded velocity-form runner over (MX, MY, 1): the distributed
+    flagship.
 
-    One cyclic k-plane ppermute pair per field (u, v) per k-block; the
-    carry stays shard-local (its halos zero-seed exactly as on a single
-    device, so for a shared block_x results are BITWISE equal across
-    mesh shapes).  The bootstrap and the remainder tail run the same
-    kernel at k=1 (the bootstrap with coeff C/2 on zero v/carry, which
-    IS the compensated half-step).
+    One cyclic k-deep ppermute pair per mesh axis per field (u, v) per
+    k-block; on 2D grids the y-row extension happens FIRST and the x
+    ghost planes are sliced from the extended blocks (the corner
+    sequencing of solver/sharded_kfused.py).  The carry stays
+    shard-local with zero-seeded halos exactly as on a single device.
+    y-sharding shrinks every VMEM plane by MY - which is what lifts the
+    VMEM bound on k (x-only at N=512 is k<=2; (8,8,1) runs k=4).  The
+    bootstrap and the remainder tail run the same kernel at k=1 (the
+    bootstrap with coeff C/2 on zero v/carry IS the compensated
+    half-step).
     """
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
+    n_x, n_y = grid
     f = stencil_ref.compute_dtype(dtype)
     nl = problem.N // n_x
+    nl_y = problem.N // n_y
     sx, ct, syz, rsyz, xmask, inv_absx = kfused._oracle_parts(problem, f)
     inv_absx = jnp.where(jnp.abs(sx) > _rel_guard_tol(f), inv_absx,
                          jnp.asarray(0.0, f))
     sxct_all = ct[:, None] * sx[None, :]
     perm_fwd = [(i, (i + 1) % n_x) for i in range(n_x)]
     perm_bwd = [(i, (i - 1) % n_x) for i in range(n_x)]
+    perm_fwd_y = [(i, (i + 1) % n_y) for i in range(n_y)]
+    perm_bwd_y = [(i, (i - 1) % n_y) for i in range(n_y)]
     start = 1 if start_step is None else start_step
     nblocks = (nsteps - start) // k
     rem = (nsteps - start) - nblocks * k
     # One block_x for every kk so the op sequence matches the
     # single-device kernel's block partitioning (bitwise contract).
-    bx = block_x or stencil_pallas.choose_kstep_comp_block(
-        problem.N, k, jnp.dtype(dtype).itemsize,
-        jnp.dtype(v_dtype).itemsize,
+    itemsizes = (
+        jnp.dtype(dtype).itemsize, jnp.dtype(v_dtype).itemsize,
         jnp.dtype(dtype).itemsize if carry_on else None,
-        depth=nl, ghosts=True,
     )
+    if n_y == 1:
+        bx = block_x or stencil_pallas.choose_kstep_comp_block(
+            problem.N, k, *itemsizes, depth=nl, ghosts=True,
+        )
+    else:
+        bx = block_x or stencil_pallas.choose_kstep_comp_block(
+            problem.N, k, *itemsizes, depth=nl, ghosts=True,
+            plane_elems=(nl_y + 2 * k) * problem.N,
+        )
     if bx is None:
         raise ValueError(
-            f"k={k} does not fit VMEM for N={problem.N} over {n_x} shards"
+            f"k={k} does not fit VMEM for N={problem.N} over "
+            f"({n_x}, {n_y}, 1) shards"
         )
 
     def ghosts(a, kk):
@@ -341,15 +373,39 @@ def _make_sharded_runner(problem, mesh, n_x, dtype, v_dtype, carry_on, k,
             lax.ppermute(a[:kk], "x", perm_bwd),
         )
 
+    def extend_y(a, kk):
+        # Only called on the n_y > 1 path (kcall dispatches the x-only
+        # kernel otherwise, matching solver/sharded_kfused.py).
+        lo = lax.ppermute(a[:, -kk:], "y", perm_fwd_y)
+        hi = lax.ppermute(a[:, :kk], "y", perm_bwd_y)
+        return jnp.concatenate([lo, a, hi], axis=1)
+
     def kcall(syz_c, rsyz_c, u, v, c, sxct_k, kk, coeff, with_err):
-        return stencil_pallas.fused_kstep_comp_sharded(
-            u, v, c, ghosts(u, kk), ghosts(v, kk), syz_c, rsyz_c,
-            sxct_k, k=kk, coeff=coeff, inv_h2=problem.inv_h2,
-            block_x=bx, interpret=interpret, with_errors=with_err,
+        if n_y == 1:
+            return stencil_pallas.fused_kstep_comp_sharded(
+                u, v, c, ghosts(u, kk), ghosts(v, kk), syz_c, rsyz_c,
+                sxct_k, k=kk, coeff=coeff, inv_h2=problem.inv_h2,
+                block_x=bx, interpret=interpret, with_errors=with_err,
+            )
+        ue, ve = extend_y(u, kk), extend_y(v, kk)
+        y0 = lax.axis_index("y") * nl_y
+        u2, v2, c2, dm, rm = stencil_pallas.fused_kstep_comp_sharded_xy(
+            ue, ve, c, ghosts(ue, kk), ghosts(ve, kk), syz_c, rsyz_c,
+            sxct_k, y0, problem.N, k=kk, nl_y=nl_y, coeff=coeff,
+            inv_h2=problem.inv_h2, block_x=bx, interpret=interpret,
+            with_errors=with_err,
         )
+        if with_err:
+            dm = lax.pmax(dm, "y")
+            rm = lax.pmax(rm, "y")
+        return u2, v2, c2, dm, rm
 
     def layer_rows(syz_c, rsyz_c, u, sxct_row):
-        return kfused._layer_rows_local(u, sxct_row, syz_c, rsyz_c, f)
+        d, r = kfused._layer_rows_local(u, sxct_row, syz_c, rsyz_c, f)
+        if n_y > 1:
+            d = lax.pmax(d, "y")
+            r = lax.pmax(r, "y")
+        return d, r
 
     def local_march(syz_c, rsyz_c, u, v, c, sxct_loc, first):
         rows_d, rows_r = [], []
@@ -390,9 +446,9 @@ def _make_sharded_runner(problem, mesh, n_x, dtype, v_dtype, carry_on, k,
             dmax, rmax, ct[: dmax.shape[0]], xmask, inv_absx
         )
 
-    state_spec = P("x")
+    state_spec = P("x", "y")
     rows_spec = P(None, "x")
-    plane_spec = P(None, None)
+    plane_spec = P("y", None)
 
     if start_step is None:
 
@@ -479,29 +535,31 @@ def solve_kfused_comp_sharded(
     devices=None,
     v_dtype=None,
     carry: bool = True,
+    mesh_shape=None,
 ) -> leapfrog.SolveResult:
-    """Distributed velocity-form compensated k-fused solve over a
-    (P, 1, 1) mesh - the flagship scheme at the reference's distributed
-    scale (mpi_new.cpp's role), with the compensated accuracy contract.
-    Requires P | N and k | N/P."""
+    """Distributed velocity-form compensated k-fused solve over an
+    (MX, MY, 1) mesh - the flagship scheme at the reference's
+    distributed scale (mpi_new.cpp's role), with the compensated
+    accuracy contract.  `n_shards` is the x-only shorthand.  Requires
+    MX | N, k | N/MX, MY | N, k <= N/MY."""
     from wavetpu.core.grid import build_mesh
+    from wavetpu.solver.sharded_kfused import _resolve_grid
 
     if devices is None:
         devices = jax.devices()
-    if n_shards is None:
-        n_shards = len(devices)
+    n_x, n_y = _resolve_grid(mesh_shape, n_shards, devices)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     v_dtype = dtype if v_dtype is None else jnp.dtype(v_dtype)
-    _validate_sharded(problem, dtype, v_dtype, carry, k, n_shards)
+    _validate_sharded(problem, dtype, v_dtype, carry, k, n_x, n_y)
     nsteps = problem.timesteps if stop_step is None else stop_step
     if not 1 <= nsteps <= problem.timesteps:
         raise ValueError(
             f"stop_step must be in [1, {problem.timesteps}], got {nsteps}"
         )
-    mesh = build_mesh((n_shards, 1, 1), devices[:n_shards])
+    mesh = build_mesh((n_x, n_y, 1), devices[: n_x * n_y])
     runner = _make_sharded_runner(
-        problem, mesh, n_shards, dtype, v_dtype, carry, k,
+        problem, mesh, (n_x, n_y), dtype, v_dtype, carry, k,
         compute_errors, nsteps, None, block_x, interpret,
     )
     out, init_s, solve_s = leapfrog._timed_compile_run(
@@ -527,6 +585,7 @@ def resume_kfused_comp_sharded(
     interpret: Optional[bool] = None,
     devices=None,
     v_dtype=None,
+    mesh_shape=None,
 ) -> leapfrog.SolveResult:
     """Re-enter the sharded velocity-form march at layer `start_step`
     from compensated checkpoint state (carry=None resumes the carry-less
@@ -535,27 +594,27 @@ def resume_kfused_comp_sharded(
     from jax.sharding import PartitionSpec as P
 
     from wavetpu.core.grid import build_mesh
+    from wavetpu.solver.sharded_kfused import _resolve_grid
 
     if devices is None:
         devices = jax.devices()
-    if n_shards is None:
-        n_shards = len(devices)
+    n_x, n_y = _resolve_grid(mesh_shape, n_shards, devices)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     v_dtype = dtype if v_dtype is None else jnp.dtype(v_dtype)
     carry_on = carry is not None
-    _validate_sharded(problem, dtype, v_dtype, carry_on, k, n_shards)
+    _validate_sharded(problem, dtype, v_dtype, carry_on, k, n_x, n_y)
     nsteps = problem.timesteps
     if not 1 <= start_step <= nsteps:
         raise ValueError(
             f"start_step must be in [1, {nsteps}], got {start_step}"
         )
-    mesh = build_mesh((n_shards, 1, 1), devices[:n_shards])
+    mesh = build_mesh((n_x, n_y, 1), devices[: n_x * n_y])
     runner = _make_sharded_runner(
-        problem, mesh, n_shards, dtype, v_dtype, carry_on, k,
+        problem, mesh, (n_x, n_y), dtype, v_dtype, carry_on, k,
         compute_errors, nsteps, start_step, block_x, interpret,
     )
-    sharding = NamedSharding(mesh, P("x"))
+    sharding = NamedSharding(mesh, P("x", "y"))
     args = (
         jax.device_put(jnp.asarray(u_cur, dtype), sharding),
         jax.device_put(jnp.asarray(v, v_dtype), sharding),
