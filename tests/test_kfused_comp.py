@@ -179,6 +179,30 @@ def test_f64_state_marches_in_f64(problem):
     assert dprev < 1e-12, dprev
 
 
+def test_bf16_carry_default_and_legacy_resume(problem, ck4, ref64):
+    # f32 runs default to a bf16 carry (the +6% HBM win; error class
+    # unchanged - ck4 above already ran with it), f64 runs keep f64.
+    assert ck4.comp_carry.dtype == jnp.bfloat16
+    r64 = kfused_comp.solve_kfused_comp(
+        problem, dtype=jnp.float64, k=4, stop_step=5, interpret=True
+    )
+    assert r64.comp_carry.dtype == jnp.float64
+    # An explicit f32 carry (legacy checkpoints) still resumes, with its
+    # dtype preserved through the march.
+    st = kfused_comp.solve_kfused_comp(
+        problem, k=4, stop_step=13, carry_dtype=jnp.float32,
+        interpret=True,
+    )
+    assert st.comp_carry.dtype == jnp.float32
+    rs = kfused_comp.resume_kfused_comp(
+        problem, st.u_cur, st.comp_v, st.comp_carry, 13, k=4,
+        interpret=True,
+    )
+    assert rs.comp_carry.dtype == jnp.float32
+    diff = np.abs(np.asarray(rs.u_cur, np.float64) - ref64).max()
+    assert diff < 1e-6, diff
+
+
 def test_errors_off(problem):
     res = kfused_comp.solve_kfused_comp(
         problem, k=4, compute_errors=False, interpret=True
