@@ -31,7 +31,7 @@ pick at runtime):
                                     to the discretization limit (5.7e-6 vs
                                     1.1e-3 L-inf at N=512/1000 on v5e);
                                     composes with --fuse-steps K into the
-                                    FLAGSHIP velocity-form onion (~36
+                                    FLAGSHIP velocity-form onion (~42
                                     Gcell/s at 5.7e-6 single-device, and
                                     sharded over --mesh MX,1,1 at K=2 for
                                     N=512 - VMEM bounds K;
@@ -64,16 +64,17 @@ pick at runtime):
                                     elsewhere (off-TPU pallas runs in
                                     interpret mode - correct but slow)
   --fuse-steps K                    temporal blocking: K leapfrog layers per
-                                    HBM pass (solver/kfused.py; 43.8 vs 20.3
+                                    HBM pass (solver/kfused.py; ~44 vs ~20
                                     Gcell/s at K=4, N=512/1000 on v5e, with
                                     per-layer errors still reported).
-                                    Requires the pallas kernel, the standard
-                                    scheme, and K | N/MX; single device or an
-                                    (MX,MY,1) mesh (--mesh ->
+                                    Requires the pallas kernel; single device
+                                    or an (MX,MY,1) mesh (--mesh ->
                                     solver/sharded_kfused.py, K-deep ghost
                                     exchange per K layers, corners via
                                     sequenced y-then-x ppermute); layers are
-                                    bitwise identical to K=1
+                                    bitwise identical to K=1, including the
+                                    uneven pad-and-mask path when K does not
+                                    divide N/MX (x-only meshes)
   --overlap                         overlap halo exchange with the bulk
                                     stencil update (sharded backend, even
                                     shard splits only)
