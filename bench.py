@@ -142,6 +142,13 @@ def main() -> int:
                 problem, k=2, interpret=interp
             ),
         ),
+        "kfused_comp_k4_noerrors": row(
+            "kfused_comp_k4_noerrors",
+            lambda: kfused_comp.solve_kfused_comp(
+                problem, k=4, compute_errors=False, interpret=interp
+            ),
+            errors_computed=False,
+        ),
         # bf16 increment form: bf16 v stream + f32 carrier u - the bf16
         # mode with meaningful numbers (BASELINE config 5 re-scoped).
         "kfused_comp_k4_bf16inc": row(

@@ -113,3 +113,34 @@ def test_two_process_kfused(tmp_path):
     np.testing.assert_allclose(
         side["abs_errors"], local.abs_errors, rtol=1e-5, atol=1e-8
     )
+
+
+def test_two_process_compensated_kfused(tmp_path):
+    """The distributed FLAGSHIP (velocity-form compensated k-fusion) runs
+    multi-process: 2 OS processes, 1 device each, rank-0 gating intact
+    and errors matching the in-process run."""
+    from wavetpu.solver import kfused_comp
+
+    out0 = str(tmp_path / "p0")
+    out1 = str(tmp_path / "p1")
+    os.makedirs(out0)
+    os.makedirs(out1)
+    port = _free_port()
+    extra = ("--scheme", "compensated", "--fuse-steps", "2")
+    procs = [
+        _launch(0, out0, port, extra), _launch(1, out1, port, extra)
+    ]
+    outs = [p.communicate(timeout=240)[0] for p in procs]
+    assert procs[0].returncode == 0, outs[0]
+    assert procs[1].returncode == 0, outs[1]
+    assert os.listdir(out1) == []
+    assert "scheme: compensated" in outs[0]
+    assert "fuse-steps: 2" in outs[0]
+
+    side = json.load(open(os.path.join(out0, "output_N16_Np2_TPU.json")))
+    local = kfused_comp.solve_kfused_comp_sharded(
+        Problem(N=16, timesteps=5), n_shards=2, k=2, interpret=True
+    )
+    np.testing.assert_allclose(
+        side["abs_errors"], local.abs_errors, rtol=1e-4, atol=1e-7
+    )
