@@ -22,6 +22,20 @@ Throughput definition (pinned; ADVICE r1): cell updates per step are
 by solve wall time (excludes compile).  vs_baseline is relative to the
 6.1 Gcell/s the round-1 judge measured for the jnp-roll path on this
 same single v5e chip.
+
+Each row also reports `model_gbps` - achieved HBM bandwidth under the
+row's documented traffic model (`model_bytes_per_cell` x measured
+Gcell/s): the roofline-visibility number (VERDICT r5 "next" #6).  The
+models are the per-scheme stream counts from the solver docstrings, not
+measurements - e.g. a 1-step f32 scheme moves 3 field-streams x 4 B =
+12 B per cell-step; the k=4 onion (bx=4) moves (4bx + 4k)/(k bx) x 4 =
+8 B.  A model_gbps far above the chip's measured ~250-310 GB/s copy
+bandwidth means the model (or the timing) is wrong - that is the point
+of printing it.
+
+Output contract (truncation-proof; VERDICT r5 weak #2): the full
+artifact line prints FIRST and a compact headline-only summary line
+prints LAST, so a 2 KB stdout tail always captures the flagship number.
 """
 
 import json
@@ -30,7 +44,7 @@ import sys
 BASELINE_GCELLS = 6.1  # r1 judge measurement, single v5e chip, jnp-roll f32
 
 
-def _run(tag, fn, errors_computed=True, best_of=2):
+def _run(tag, fn, errors_computed=True, best_of=2, bytes_per_cell=None):
     """Execute one benchmark config best-of-N; failures recorded, not fatal.
 
     Each run builds a fresh jitted program (compile #2 hits the cache) -
@@ -60,7 +74,7 @@ def _run(tag, fn, errors_computed=True, best_of=2):
             traceback.print_exc()
     if best is None:
         return {"error": "failed; see stderr"}
-    return {
+    row = {
         "gcells_per_s": round(best.gcells_per_second, 3),
         "max_abs_error": (
             float(best.abs_errors.max()) if errors_computed else None
@@ -72,7 +86,15 @@ def _run(tag, fn, errors_computed=True, best_of=2):
         # round-4 verdict flagged compile-time growth as unwatched while
         # kernels multiply.
         "compile_seconds": round(cold_compile, 3),
-    }, best
+    }
+    if bytes_per_cell is not None:
+        # Modeled HBM traffic per cell-step (see module docstring) times
+        # achieved throughput = achieved GB/s on the roofline.
+        row["model_bytes_per_cell"] = bytes_per_cell
+        row["model_gbps"] = round(
+            best.gcells_per_second * bytes_per_cell, 1
+        )
+    return row, best
 
 
 def main() -> int:
@@ -80,7 +102,7 @@ def main() -> int:
     import jax.numpy as jnp
 
     from wavetpu.core.problem import Problem
-    from wavetpu.kernels import stencil_pallas
+    from wavetpu.kernels import stencil_pallas, stencil_ref
     from wavetpu.solver import (
         kfused,
         kfused_comp,
@@ -96,10 +118,14 @@ def main() -> int:
     on_tpu = jax.default_backend() == "tpu"
     interp = not on_tpu
 
+    # Per-row HBM traffic models (B per cell-step; see module docstring).
+    # Onion rows: state itemsize * (in planes + out planes) / (k * bx)
+    # with the chooser's bx at N=512; 1-step rows: streams * itemsize.
     backend = "pallas velocity-form compensated k=4"
     head_row = _run(
         "headline_kfused_comp_k4",
         lambda: kfused_comp.solve_kfused_comp(problem, k=4, interpret=interp),
+        bytes_per_cell=9,   # u 16pl*4B + v 16pl*4B + carry 8pl*2B over 16
     )
     if isinstance(head_row, dict):  # both runs failed
         print("headline comp k-fused failed, falling back to jnp-roll:",
@@ -114,16 +140,86 @@ def main() -> int:
             return 1
     head = head_row[0]
 
-    def row(tag, fn, errors_computed=True):
-        out = _run(tag, fn, errors_computed)
+    def row(tag, fn, errors_computed=True, bytes_per_cell=None):
+        out = _run(tag, fn, errors_computed, bytes_per_cell=bytes_per_cell)
         return out[0] if isinstance(out, tuple) else out
 
+    # Variable-c field for the kfused_varc rows: a stable two-layer
+    # interface (far z half at HALF speed-squared, so max c^2 = a^2 and
+    # the constant-c Courant bound still holds at N=512/1000 - the CLI's
+    # two-layer preset doubles c^2 instead, which is Courant-unstable at
+    # this config).  No analytic oracle -> errors off.
+    import numpy as _np
+
+    varc_field = stencil_ref.make_c2tau2_field(
+        problem,
+        lambda x, y, z: _np.where(
+            z < problem.Lz / 2, problem.a2, 0.5 * problem.a2
+        ) + 0.0 * x + 0.0 * y,
+    )
+
+    # kfused_varc: the composition this round exists for - variable c at
+    # onion speed.  k=4/bx=4 models ~5% over the 128 MiB VMEM ceiling
+    # (choose_kstep_block docstring), so it is ATTEMPTED explicitly and
+    # the outcome recorded; the model-blessed k=2 config is the fallback.
+    varc_tag = "kfused_varc_k4_bx4"
+    varc_out = _run(
+        "kfused_varc_k4_bx4",
+        lambda: kfused.solve_kfused(
+            problem, k=4, block_x=4, compute_errors=False,
+            interpret=interp, c2tau2_field=varc_field,
+        ),
+        errors_computed=False,
+        bytes_per_cell=11,  # (32 state + 12 field planes)*4B over 16
+    )
+    if not isinstance(varc_out, tuple):
+        varc_tag = "kfused_varc_k2"
+        varc_out = _run(
+            "kfused_varc_k2",
+            lambda: kfused.solve_kfused(
+                problem, k=2, compute_errors=False, interpret=interp,
+                c2tau2_field=varc_field,
+            ),
+            errors_computed=False,
+            bytes_per_cell=16,  # (24 state + 8 field planes)*4B over 8
+        )
+    varc_row = varc_out[0] if isinstance(varc_out, tuple) else varc_out
+    varc_row = dict(varc_row, config=varc_tag)
+
     subs = {
+        # Variable-c at onion speed (this round's composition).
+        "kfused_varc": varc_row,
+        # 1-step variable-c pallas: the before picture for the varc row.
+        "pallas_1step_varc": row(
+            "pallas_1step_varc",
+            lambda: leapfrog.solve(
+                problem,
+                step_fn=stencil_pallas.make_step_fn(
+                    interpret=interp, c2tau2_field=varc_field
+                ),
+                compute_errors=False,
+            ),
+            errors_computed=False,
+            bytes_per_cell=16,  # u_prev + u + field in, u_next out, f32
+        ),
+        # Variable-c bf16-increment velocity form - BASELINE config 5 in
+        # its meaningful composition (k=2 = the model-fit config).
+        "kfused_comp_varc_k2_bf16inc": row(
+            "kfused_comp_varc_k2_bf16inc",
+            lambda: kfused_comp.solve_kfused_comp(
+                problem, k=2, v_dtype=jnp.bfloat16, carry=False,
+                compute_errors=False, interpret=interp,
+                c2tau2_field=varc_field,
+            ),
+            errors_computed=False,
+            bytes_per_cell=13,  # u 12pl*4 + v 12pl*2 + field 8pl*4 over 8
+        ),
         # The round-4 headline: max speed with the standard scheme
         # (rounding-dominated error; see accuracy_note).
         "kfused_k4_f32": row(
             "kfused_k4_f32",
             lambda: kfused.solve_kfused(problem, k=4, interpret=interp),
+            bytes_per_cell=8,   # (4bx + 4k) = 32 planes * 4B over 16
         ),
         "kfused_k4_f32_noerrors": row(
             "kfused_k4_f32_noerrors",
@@ -131,16 +227,19 @@ def main() -> int:
                 problem, k=4, compute_errors=False, interpret=interp
             ),
             errors_computed=False,
+            bytes_per_cell=8,
         ),
         "kfused_k2_f32": row(
             "kfused_k2_f32",
             lambda: kfused.solve_kfused(problem, k=2, interpret=interp),
+            bytes_per_cell=10,  # bx=8: 40 planes * 4B over 16
         ),
         "kfused_comp_k2_f32": row(
             "kfused_comp_k2_f32",
             lambda: kfused_comp.solve_kfused_comp(
                 problem, k=2, interpret=interp
             ),
+            bytes_per_cell=14,  # u 12pl*4 + v 12pl*4 + carry 8pl*2 over 8
         ),
         "kfused_comp_k4_noerrors": row(
             "kfused_comp_k4_noerrors",
@@ -148,6 +247,7 @@ def main() -> int:
                 problem, k=4, compute_errors=False, interpret=interp
             ),
             errors_computed=False,
+            bytes_per_cell=9,
         ),
         # bf16 increment form: bf16 v stream + f32 carrier u - the bf16
         # mode with meaningful numbers (BASELINE config 5 re-scoped).
@@ -157,6 +257,7 @@ def main() -> int:
                 problem, k=4, v_dtype=jnp.bfloat16, carry=False,
                 interpret=interp,
             ),
+            bytes_per_cell=6,   # u 16pl*4B + v 16pl*2B over 16
         ),
         # bf16 carrier state: throughput demo ONLY - its per-step
         # increments sit below the bf16 ulp, so max_abs_error is O(1)
@@ -166,6 +267,7 @@ def main() -> int:
             lambda: kfused.solve_kfused(
                 problem, dtype=jnp.bfloat16, k=4, interpret=interp
             ),
+            bytes_per_cell=3,   # bx=8: 48 planes * 2B over 32
         ),
         "bf16_pallas_1step": row(
             "bf16_pallas_1step",
@@ -174,12 +276,14 @@ def main() -> int:
                 dtype=jnp.bfloat16,
                 step_fn=stencil_pallas.make_step_fn(interpret=interp),
             ),
+            bytes_per_cell=6,
         ),
         "pallas_1step_f32": row(
             "pallas_1step_f32",
             lambda: leapfrog.solve(
                 problem, step_fn=stencil_pallas.make_step_fn(interpret=interp)
             ),
+            bytes_per_cell=12,  # 3 f32 field-streams
         ),
         "compensated_pallas_f32": row(
             "compensated_pallas_f32",
@@ -189,21 +293,25 @@ def main() -> int:
                     interpret=interp
                 ),
             ),
+            bytes_per_cell=24,  # u/v/carry in + out, all f32
         ),
         "jnp_roll_f32": row(
-            "jnp_roll_f32", lambda: leapfrog.solve(problem)
+            "jnp_roll_f32", lambda: leapfrog.solve(problem),
+            bytes_per_cell=12,  # lower bound; XLA roll temps add more
         ),
         "sharded_pallas_mesh111": row(
             "sharded_pallas_mesh111",
             lambda: sharded.solve_sharded(
                 problem, mesh_shape=(1, 1, 1), kernel="pallas"
             ),
+            bytes_per_cell=12,
         ),
         "sharded_kfused_k4_1shard": row(
             "sharded_kfused_k4_1shard",
             lambda: sharded_kfused.solve_sharded_kfused(
                 problem, n_shards=1, k=4, interpret=interp
             ),
+            bytes_per_cell=8,
         ),
         # Distributed velocity-form flagship (x-only); k=2 is the VMEM
         # ceiling at N=512 (the 4 full-plane ghost buffers of k=4 push
@@ -213,6 +321,7 @@ def main() -> int:
             lambda: kfused_comp.solve_kfused_comp_sharded(
                 problem, n_shards=1, k=2, interpret=interp
             ),
+            bytes_per_cell=14,
         ),
     }
     line = {
@@ -244,6 +353,20 @@ def main() -> int:
         "baseline_note": "6.1 Gcell/s = round-1 judge measurement, same chip",
     }
     print(json.dumps(line))
+    # Compact headline summary LAST: a 2 KB stdout tail always captures
+    # the flagship number even if the full artifact line is cut.
+    summary = {
+        "metric": "gcell_updates_per_s",
+        "value": head["gcells_per_s"],
+        "unit": "Gcell/s",
+        "vs_baseline": line["vs_baseline"],
+        "max_abs_error": head["max_abs_error"],
+        "solve_seconds": head["solve_seconds"],
+        "config": line["config"],
+        "kfused_varc_gcells_per_s": varc_row.get("gcells_per_s"),
+        "headline_summary": True,
+    }
+    print(json.dumps(summary))
     return 0
 
 

@@ -85,6 +85,64 @@ def solve_reference(p: Problem) -> np.ndarray:
     return u
 
 
+def solve_reference_variable_c(p: Problem, c2_fn) -> np.ndarray:
+    """Full history (timesteps+1, N+1, N+1, N+1), float64, under a
+    spatially varying squared wave speed c^2(x, y, z).
+
+    Written from the scheme, not from any implementation under test: the
+    leapfrog update u^{n+1} = 2u^n - u^{n-1} + tau^2 c^2(x) lap(u^n) with
+    the pointwise coefficient, the Taylor half-step bootstrap
+    u^1 = u^0 + (tau^2 c^2(x)/2) lap(u^0), the duplicated periodic seam
+    in x (node 0 == node N, wrapped neighbours N-1 and 1), and zeroed
+    Dirichlet faces in y/z - exactly `solve_reference` with the scalar
+    a^2 tau^2 replaced by the per-node field.  `c2_fn` takes
+    broadcastable (x, y, z) coordinate arrays (same convention as
+    `stencil_ref.make_c2tau2_field`).
+
+    The fundamental-domain mapping is history[:, :N, :N, :N]: node i of
+    the (N+1)-grid sits at x = i*hx, which is the framework's stored
+    point i for i < N (the seam node N duplicates node 0).
+    """
+    N, ts = p.N, p.timesteps
+    x = (np.arange(N + 1, dtype=np.float64) * p.hx)[:, None, None]
+    y = (np.arange(N + 1, dtype=np.float64) * p.hy)[None, :, None]
+    z = (np.arange(N + 1, dtype=np.float64) * p.hz)[None, None, :]
+    c2t2 = np.broadcast_to(
+        np.asarray(c2_fn(x, y, z), dtype=np.float64) * p.tau * p.tau,
+        (N + 1, N + 1, N + 1),
+    )
+    ci = c2t2[1:-1, 1:-1, 1:-1]   # interior coefficient
+    cs = c2t2[N, 1:-1, 1:-1]      # seam-plane coefficient
+    u = np.zeros((ts + 1, N + 1, N + 1, N + 1), dtype=np.float64)
+
+    u[0] = full_analytic_grid(p, 0)
+
+    _zero_faces(u[1])
+    u[1][N, 1:-1, 1:-1] = (
+        u[0][N, 1:-1, 1:-1] + 0.5 * cs * _seam_lap(u[0], p)
+    )
+    u[1][0, 1:-1, 1:-1] = u[1][N, 1:-1, 1:-1]
+    u[1][1:-1, 1:-1, 1:-1] = (
+        u[0][1:-1, 1:-1, 1:-1] + 0.5 * ci * _interior_lap(u[0], p)
+    )
+    _zero_faces(u[1])
+
+    for n in range(2, ts + 1):
+        _zero_faces(u[n])
+        u[n][N, 1:-1, 1:-1] = (
+            2 * u[n - 1][N, 1:-1, 1:-1]
+            - u[n - 2][N, 1:-1, 1:-1]
+            + cs * _seam_lap(u[n - 1], p)
+        )
+        u[n][0, 1:-1, 1:-1] = u[n][N, 1:-1, 1:-1]
+        u[n][1:-1, 1:-1, 1:-1] = (
+            2 * u[n - 1][1:-1, 1:-1, 1:-1]
+            - u[n - 2][1:-1, 1:-1, 1:-1]
+            + ci * _interior_lap(u[n - 1], p)
+        )
+    return u
+
+
 def reference_errors(p: Problem, history: np.ndarray):
     """Post-hoc per-layer L-inf abs/rel errors over interior [1..N-1]^3,
     the reference's `calculate_error` metric."""

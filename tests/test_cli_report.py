@@ -230,6 +230,7 @@ def test_cli_compensated_kfused(tmp_path, capsys):
     assert side["run_config"]["v_dtype"] == "bf16"
 
 
+@pytest.mark.heavy
 def test_cli_compensated_kfused_sharded(tmp_path, capsys):
     """--scheme compensated --fuse-steps K --mesh MX,1,1 runs the
     distributed velocity-form flagship, checkpoints per shard, and
@@ -263,6 +264,7 @@ def test_cli_compensated_kfused_sharded(tmp_path, capsys):
     assert side["run_config"]["mesh"] == [2, 2, 1]
 
 
+@pytest.mark.heavy
 def test_cli_compensated_kfused_resume(tmp_path, capsys):
     """A compensated checkpoint resumes onto the k-fused path; stopping on
     a block-aligned layer keeps the remaining march's op sequence equal,
@@ -321,6 +323,7 @@ def test_cli_fuse_steps_resume_guards(tmp_path, capsys):
     assert "(MX,MY,1)" in err
 
 
+@pytest.mark.heavy
 def test_cli_fuse_steps_sharded(tmp_path, capsys):
     """--fuse-steps + --mesh MX,MY,1 runs the sharded k-fused solver and
     matches the single-device k-fused report; z-sharded meshes are
@@ -350,6 +353,7 @@ def test_cli_fuse_steps_sharded(tmp_path, capsys):
     assert xy["abs_errors"] == pytest.approx(one["abs_errors"], rel=1e-5)
 
 
+@pytest.mark.heavy
 def test_cli_fuse_steps_sharded_resume(tmp_path, capsys):
     """An x-only sharded checkpoint resumes under --fuse-steps with the
     same error tail as the uninterrupted sharded k-fused run."""
@@ -450,19 +454,97 @@ def test_cli_c2_field(tmp_path, capsys):
     capsys.readouterr()
     assert os.path.exists(tmp_path / "sh" / "output_N12_Np4_TPU.txt")
 
-    # Misuse rejected before compute.
+    # Misuse rejected before compute: 1-step compensated has no field
+    # kernel (the velocity-form onion takes it; --fuse-steps required),
+    # and malformed fields fail fast.
     assert cli.main(base + ["--c2-field", "nope-not-a-preset"]) == 2
     assert cli.main(
         base + ["--c2-field", "constant", "--scheme", "compensated"]
-    ) == 2
-    assert cli.main(
-        base + ["--c2-field", "constant", "--fuse-steps", "2"]
     ) == 2
     np.save(str(tmp_path / "bad.npy"), np.zeros((3, 3, 3)))
     assert cli.main(
         base + ["--c2-field", str(tmp_path / "bad.npy")]
     ) == 2
     capsys.readouterr()
+
+
+def test_cli_c2_field_kfused(tmp_path, capsys):
+    """--c2-field composes with --fuse-steps (round 6): the standard
+    onion, the sharded onion, and the velocity-form compensated onion
+    (incl. --v-dtype bf16) all run end-to-end with the oracle disabled,
+    and a variable-c k-fused checkpoint resumes under the re-passed
+    field with the same final state."""
+    base = ["12", "1", "1", "1", "1", "1", "6"]
+    assert cli.main(
+        base + ["--c2-field", "two-layer", "--fuse-steps", "2",
+                "--backend", "single", "--out-dir", str(tmp_path)]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "errors: disabled" in out and "fuse-steps: 2" in out
+    side = json.load(open(tmp_path / "output_N12_Np1_TPU.json"))
+    assert side["run_config"]["c2_field"] == "two-layer"
+    assert side["run_config"]["fuse_steps"] == 2
+    # Sharded (2D mesh) composition.
+    assert cli.main(
+        base + ["--c2-field", "two-layer", "--fuse-steps", "2",
+                "--mesh", "2,2,1", "--out-dir", str(tmp_path / "sh")]
+    ) == 0
+    # Velocity-form compensated onion with the field, incl. bf16-v.
+    assert cli.main(
+        base + ["--c2-field", "two-layer", "--scheme", "compensated",
+                "--fuse-steps", "2", "--out-dir", str(tmp_path / "c")]
+    ) == 0
+    assert cli.main(
+        base + ["--c2-field", "two-layer", "--scheme", "compensated",
+                "--fuse-steps", "2", "--v-dtype", "bf16",
+                "--out-dir", str(tmp_path / "cb")]
+    ) == 0
+    capsys.readouterr()
+    # Checkpoint/resume under the field: the resumed run re-passes
+    # --c2-field and must land on the uninterrupted run's state (the
+    # sidecar only records state, never the field).
+    full_dir = str(tmp_path / "full")
+    ck = str(tmp_path / "ck.npz")
+    args = base + ["--c2-field", "two-layer", "--fuse-steps", "2",
+                   "--backend", "single"]
+    assert cli.main(args + ["--out-dir", full_dir]) == 0
+    assert cli.main(
+        args + ["--stop-step", "3", "--save-state", ck,
+                "--out-dir", str(tmp_path / "part")]
+    ) == 0
+    res_dir = str(tmp_path / "res")
+    assert cli.main(
+        ["--resume", ck, "--c2-field", "two-layer", "--fuse-steps", "2",
+         "--out-dir", res_dir]
+    ) == 0
+    capsys.readouterr()
+    full = json.load(open(os.path.join(full_dir, "output_N12_Np1_TPU.json")))
+    rs = json.load(open(os.path.join(res_dir, "output_N12_Np1_TPU.json")))
+    assert rs["run_config"]["resumed"] is True
+    assert rs["run_config"]["c2_field"] == "two-layer"
+    # Errors are off for variable c, so compare the recorded config and
+    # that both runs completed to the same final step.
+    assert full["run_config"]["fuse_steps"] == rs["run_config"]["fuse_steps"]
+
+
+def test_cli_compensated_kfused_phase_timing(tmp_path, capsys):
+    """--phase-timing now covers the velocity-form onion (round 6): a
+    compensated k-fused sharded run reports the loop/exchange split;
+    the 1-step compensated scheme still has no probe and is refused."""
+    rc = cli.main(
+        ["16", "1", "1", "1", "1", "1", "8", "--scheme", "compensated",
+         "--fuse-steps", "4", "--mesh", "2,1,1", "--phase-timing",
+         "--out-dir", str(tmp_path)]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "total loop time:" in out and "total ICI exchange time:" in out
+    assert cli.main(
+        ["16", "1", "1", "1", "1", "1", "8", "--scheme", "compensated",
+         "--phase-timing", "--out-dir", str(tmp_path)]
+    ) == 2
+    err = capsys.readouterr().err
+    assert "1-step scheme has none" in err
 
 
 def test_cli_debug_nans_flag(tmp_path):
@@ -480,6 +562,7 @@ def test_cli_debug_nans_flag(tmp_path):
         jax.config.update("jax_debug_nans", False)
 
 
+@pytest.mark.heavy
 def test_cli_resumed_kfused_phase_timing_uses_checkpoint_mesh(
     tmp_path, capsys
 ):
@@ -500,6 +583,7 @@ def test_cli_resumed_kfused_phase_timing_uses_checkpoint_mesh(
     assert "total loop time:" in capsys.readouterr().out
 
 
+@pytest.mark.heavy
 def test_cli_resumed_xy_kfused_phase_timing(tmp_path, capsys):
     """--phase-timing now covers 2D-mesh k-fused runs (round-5): a
     resumed (2,2,1) checkpoint probes the xy program and reports the

@@ -76,6 +76,7 @@ def test_against_jnp_roll_reference():
     )
 
 
+@pytest.mark.heavy
 def test_stop_resume_bitwise_across_paths():
     """stop at an arbitrary layer (not a k boundary), resume k-fused OR
     1-step: all three final states bitwise equal the uninterrupted run."""

@@ -85,6 +85,7 @@ def test_rel_errors_guarded_and_sane(ck4, comp1):
     assert comp1.rel_errors.max() > 0.1  # the unguarded metric's noise
 
 
+@pytest.mark.heavy
 def test_block_aligned_resume_bitwise(problem, ck4):
     # stop=13 is block-aligned from start=1 (blocks [2-5][6-9][10-13]);
     # the resumed march emits the identical remaining block sequence.
@@ -103,6 +104,7 @@ def test_block_aligned_resume_bitwise(problem, ck4):
     assert np.all(rs.abs_errors[:14] == 0.0)
 
 
+@pytest.mark.heavy
 def test_misaligned_resume_tolerance(problem, ck4, ref64):
     # stop=14 shifts the block grid (resume marches [15-18] + 3-layer
     # k=1 tail vs the full run's [14-17][18-21]): different op order, so
@@ -151,6 +153,7 @@ def test_bf16_increment_form(problem, ref64):
     assert diff < 5e-3, diff
 
 
+@pytest.mark.heavy
 def test_bf16_increment_resume(problem):
     st = kfused_comp.solve_kfused_comp(
         problem, k=4, stop_step=13, v_dtype=jnp.bfloat16, carry=False,
@@ -179,6 +182,7 @@ def test_f64_state_marches_in_f64(problem):
     assert dprev < 1e-12, dprev
 
 
+@pytest.mark.heavy
 def test_bf16_carry_default_and_legacy_resume(problem, ck4, ref64):
     # f32 runs default to a bf16 carry (the +6% HBM win; error class
     # unchanged - ck4 above already ran with it), f64 runs keep f64.
@@ -219,6 +223,7 @@ def test_errors_off(problem):
 
 
 @pytest.mark.parametrize("n_shards", [1, 2, 4])
+@pytest.mark.heavy
 def test_sharded_matches_single_device(problem, ck4, n_shards):
     got = kfused_comp.solve_kfused_comp_sharded(
         problem, n_shards=n_shards, k=4, block_x=4, interpret=True
@@ -243,6 +248,7 @@ def test_sharded_matches_single_device(problem, ck4, n_shards):
     assert d2 < 1e-6, d2
 
 
+@pytest.mark.heavy
 def test_sharded_checkpoint_roundtrip(problem, tmp_path):
     from wavetpu.io import checkpoint as ckpt
 
@@ -281,6 +287,7 @@ def test_sharded_bf16_increment(problem, ref64):
 
 
 @pytest.mark.parametrize("mesh", [(2, 2, 1), (1, 2, 1), (2, 4, 1)])
+@pytest.mark.heavy
 def test_sharded_xy_matches_single_device(problem, mesh):
     """2D-mesh velocity-form k-fusion (y-extended blocks, wrapped-global-y
     increment mask, corners via sequenced exchange) agrees with the
@@ -304,6 +311,7 @@ def test_sharded_xy_matches_single_device(problem, mesh):
     )
 
 
+@pytest.mark.heavy
 def test_sharded_xy_checkpoint_roundtrip(problem, tmp_path):
     from wavetpu.io import checkpoint as ckpt
 

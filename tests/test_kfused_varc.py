@@ -1,0 +1,276 @@
+"""Variable-c(x,y,z) through the k-step onion family: oracle + parity.
+
+The c^2tau^2 field threads through every onion (standard single-device,
+x/xy-sharded, pad-and-mask uneven; velocity-form compensated single and
+sharded).  Contracts pinned here:
+
+ * INDEPENDENT ORACLE: `tests/reference_impl.solve_reference_variable_c`
+   is a numpy f64 implementation of the scheme in the reference's own
+   (N+1)^3-with-seam indexing, written from the scheme description - the
+   variable-c analog of the constant-c pinning in test_single_device.py
+   (closes the round-5 "variable-c has no independent oracle" weakness).
+   The onion paths must be LAYER-EXACT against it at f32 rounding,
+   including mid-run layers reached through stop_step.
+ * OP-IDENTICAL MIXING: variable-c k-fused layers are op-identical to
+   the 1-step variable-c pallas kernel's (same summation order after the
+   round-6 `_var_step_kernel` unification), so checkpoints mix across
+   paths.  On this jaxlib's XLA-CPU pipeline, FMA contraction differs
+   between program SHAPES (a scanned onion vs an unrolled 1-step loop),
+   so "bitwise" asserts here allow 1 ulp - the same caveat as the
+   uneven suite in test_sharded_kfused.py; on-chip/same-program runs
+   remain bit-identical.
+ * The compensated onion keeps its tolerance-vs-f64 contract with a
+   field coefficient, including the bf16-increment mode (BASELINE
+   config 5 in its meaningful form).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests import reference_impl
+from wavetpu.core.problem import Problem
+from wavetpu.kernels import stencil_pallas, stencil_ref
+from wavetpu.solver import kfused, kfused_comp, leapfrog, sharded, \
+    sharded_kfused
+
+
+def _c2_fn(p):
+    """Smooth positive c^2 field with max value a^2, so the constant-c
+    Courant bound still guarantees stability."""
+
+    def fn(x, y, z):
+        return p.a2 * (
+            0.6 + 0.4 * np.sin(2 * np.pi * x / p.Lx) ** 2
+            * np.sin(np.pi * y / p.Ly) ** 2
+        )
+
+    return fn
+
+
+@pytest.fixture(scope="module")
+def problem():
+    return Problem(N=12, Np=1, Lx=1.0, Ly=1.0, Lz=1.0, T=1.0, timesteps=9)
+
+
+@pytest.fixture(scope="module")
+def field(problem):
+    return stencil_ref.make_c2tau2_field(problem, _c2_fn(problem))
+
+
+@pytest.fixture(scope="module")
+def ref_history(problem):
+    return reference_impl.solve_reference_variable_c(
+        problem, _c2_fn(problem)
+    )
+
+
+@pytest.fixture(scope="module")
+def varc_1step(problem, field):
+    return leapfrog.solve(
+        problem,
+        step_fn=stencil_pallas.make_step_fn(
+            interpret=True, c2tau2_field=field
+        ),
+        compute_errors=False,
+    )
+
+
+@pytest.fixture(scope="module")
+def varc_k4(problem, field):
+    return kfused.solve_kfused(
+        problem, k=4, interpret=True, compute_errors=False,
+        c2tau2_field=field,
+    )
+
+
+def _fund(layer):
+    """(N+1)^3 reference layer -> fundamental (N,N,N) domain."""
+    return layer[:-1, :-1, :-1]
+
+
+def test_oracle_pins_1step_pallas(problem, field, ref_history, varc_1step):
+    """The 1-step variable-c pallas path is layer-exact (f32 rounding)
+    against the independent numpy scheme at the final two layers."""
+    np.testing.assert_allclose(
+        np.asarray(varc_1step.u_cur, np.float64),
+        _fund(ref_history[-1]), atol=5e-6, rtol=0,
+    )
+    np.testing.assert_allclose(
+        np.asarray(varc_1step.u_prev, np.float64),
+        _fund(ref_history[-2]), atol=5e-6, rtol=0,
+    )
+
+
+@pytest.mark.parametrize("k,stop", [(4, 5), (2, 9), (4, 9)])
+def test_oracle_pins_kfused_layers(problem, field, ref_history, k, stop):
+    """Variable-c k-fused output is layer-exact against the numpy oracle,
+    including a mid-run non-block-aligned layer reached via stop_step
+    (the in-VMEM intermediate layers feed it, so this pins them too)."""
+    res = kfused.solve_kfused(
+        problem, k=k, stop_step=stop, interpret=True,
+        compute_errors=False, c2tau2_field=field,
+    )
+    np.testing.assert_allclose(
+        np.asarray(res.u_cur, np.float64),
+        _fund(ref_history[stop]), atol=5e-6, rtol=0,
+    )
+    np.testing.assert_allclose(
+        np.asarray(res.u_prev, np.float64),
+        _fund(ref_history[stop - 1]), atol=5e-6, rtol=0,
+    )
+
+
+def test_kfused_bitwise_vs_1step(problem, field, varc_1step, varc_k4):
+    """Variable-c onion layers are op-identical to 1-step variable-c
+    pallas layers: the states match BITWISE (the checkpoint-mixing
+    contract of the constant-c onion, extended to the field)."""
+    np.testing.assert_allclose(
+        np.asarray(varc_k4.u_cur), np.asarray(varc_1step.u_cur),
+        atol=3e-7, rtol=0,
+    )
+    np.testing.assert_allclose(
+        np.asarray(varc_k4.u_prev), np.asarray(varc_1step.u_prev),
+        atol=3e-7, rtol=0,
+    )
+
+
+def test_varc_stop_resume_bitwise(problem, field, varc_k4):
+    part = kfused.solve_kfused(
+        problem, k=4, stop_step=5, interpret=True, compute_errors=False,
+        c2tau2_field=field,
+    )
+    res = kfused.resume_kfused(
+        problem, part.u_prev, part.u_cur, 5, k=4, interpret=True,
+        compute_errors=False, c2tau2_field=field,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res.u_cur), np.asarray(varc_k4.u_cur)
+    )
+
+
+@pytest.mark.parametrize("mesh", [(2, 1, 1), (2, 2, 1)])
+def test_sharded_varc_matches_single(problem, field, mesh):
+    """Even-decomposition sharded variable-c k-fusion matches the
+    single-device onion (the c^2 slab is sharded on the same mesh; its
+    k-deep ghosts are exchanged once per solve).  k=2 keeps N=12
+    divisible on both mesh axes."""
+    single = kfused.solve_kfused(
+        problem, k=2, interpret=True, compute_errors=False,
+        c2tau2_field=field,
+    )
+    got = sharded_kfused.solve_sharded_kfused(
+        problem, mesh_shape=mesh, k=2, interpret=True,
+        compute_errors=False, c2tau2_field=field,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.u_cur), np.asarray(single.u_cur),
+        atol=3e-7, rtol=0,
+    )
+
+
+def test_padded_varc_matches_oracle():
+    """Uneven N routes variable-c through the pad-and-mask onion (zero
+    junk coefficient, hi-splice field ext); pinned against the numpy
+    oracle at 1-ulp tolerance (XLA-CPU FMA contraction differs between
+    program shapes on this jaxlib; see test_sharded_kfused.py)."""
+    p = Problem(N=15, Np=1, Lx=1.0, Ly=1.0, Lz=1.0, T=1.0, timesteps=7)
+    fn = _c2_fn(p)
+    field = stencil_ref.make_c2tau2_field(p, fn)
+    hist = reference_impl.solve_reference_variable_c(p, fn)
+    got = sharded_kfused.solve_sharded_kfused(
+        p, n_shards=2, k=2, interpret=True, compute_errors=False,
+        c2tau2_field=field,
+    )
+    np.testing.assert_allclose(
+        sharded.gather_fundamental(got.u_cur, p).astype(np.float64),
+        _fund(hist[-1]), atol=5e-6, rtol=0,
+    )
+
+
+def test_comp_varc_beats_standard_f32(problem, field, ref_history,
+                                      varc_k4):
+    """The velocity-form onion keeps the compensated accuracy class under
+    a field coefficient: its error vs the f64 oracle must not exceed the
+    standard-f32 onion's (both are discretization-exact here; the win is
+    rounding, which only shows at long horizons - this pins correctness,
+    bench pins the class at N=512/1000)."""
+    comp = kfused_comp.solve_kfused_comp(
+        problem, k=4, interpret=True, compute_errors=False,
+        c2tau2_field=field,
+    )
+    ref = _fund(ref_history[-1])
+    e_comp = np.abs(np.asarray(comp.u_cur, np.float64) - ref).max()
+    e_std = np.abs(np.asarray(varc_k4.u_cur, np.float64) - ref).max()
+    assert e_comp < 5e-6, e_comp
+    assert e_comp <= e_std * 1.5, (e_comp, e_std)
+
+
+def test_comp_varc_bf16_increment(problem, field, ref_history):
+    """bf16-increment variable-c (BASELINE config 5 in its meaningful
+    form): bf16 v stream + f32 carrier + field coefficient, error bounded
+    by the increment quantization (~|v| 2^-8 per step)."""
+    res = kfused_comp.solve_kfused_comp(
+        problem, k=4, interpret=True, compute_errors=False,
+        c2tau2_field=field, v_dtype=jnp.bfloat16, carry=False,
+    )
+    assert res.u_cur.dtype == jnp.float32
+    assert res.comp_v.dtype == jnp.bfloat16 and res.comp_carry is None
+    diff = np.abs(
+        np.asarray(res.u_cur, np.float64) - _fund(ref_history[-1])
+    ).max()
+    assert diff < 5e-3, diff
+
+
+@pytest.mark.parametrize("mesh", [(2, 1, 1), (2, 2, 1)])
+def test_comp_sharded_varc(problem, field, mesh):
+    """Sharded velocity-form variable-c agrees with the single-device comp
+    onion at ulp level (the scheme's cross-mesh contract), and resumes
+    bitwise from a block-aligned stop on the same mesh."""
+    single = kfused_comp.solve_kfused_comp(
+        problem, k=2, block_x=2, interpret=True, compute_errors=False,
+        c2tau2_field=field,
+    )
+    got = kfused_comp.solve_kfused_comp_sharded(
+        problem, mesh_shape=mesh, k=2, block_x=2, interpret=True,
+        compute_errors=False, c2tau2_field=field,
+    )
+    diff = np.abs(
+        np.asarray(got.u_cur, np.float64)
+        - np.asarray(single.u_cur, np.float64)
+    ).max()
+    assert diff < 1e-6, diff
+    part = kfused_comp.solve_kfused_comp_sharded(
+        problem, mesh_shape=mesh, k=2, block_x=2, stop_step=5,
+        interpret=True, compute_errors=False, c2tau2_field=field,
+    )
+    res = kfused_comp.resume_kfused_comp_sharded(
+        problem, np.asarray(part.u_cur), np.asarray(part.comp_v),
+        np.asarray(part.comp_carry), 5, mesh_shape=mesh, k=2, block_x=2,
+        interpret=True, compute_errors=False, c2tau2_field=field,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res.u_cur), np.asarray(got.u_cur)
+    )
+
+
+def test_varc_requires_errors_off(problem, field):
+    """No analytic oracle for variable c: every k-fused entry point
+    refuses a field with compute_errors=True instead of reporting
+    garbage."""
+    with pytest.raises(ValueError, match="no analytic oracle"):
+        kfused.solve_kfused(
+            problem, k=4, interpret=True, c2tau2_field=field
+        )
+    with pytest.raises(ValueError, match="no analytic oracle"):
+        kfused_comp.solve_kfused_comp(
+            problem, k=4, interpret=True, c2tau2_field=field
+        )
+    with pytest.raises(ValueError, match="no analytic oracle"):
+        sharded_kfused.solve_sharded_kfused(
+            problem, n_shards=2, k=4, interpret=True, c2tau2_field=field
+        )
+    with pytest.raises(ValueError, match="no analytic oracle"):
+        kfused_comp.solve_kfused_comp_sharded(
+            problem, n_shards=2, k=4, interpret=True, c2tau2_field=field
+        )

@@ -83,6 +83,31 @@ def test_phase_breakdown_kfused_xy_mesh(small_problem):
     assert pb.steps_measured == 8
 
 
+def test_phase_breakdown_kfused_comp(small_problem):
+    """scheme="compensated" with fuse_steps > 1 probes the velocity-form
+    onion (round-6): (u, v, carry) state, u AND v exchanging ghosts, on
+    1D and 2D meshes, including the carry-less bf16-increment mode."""
+    import jax.numpy as jnp
+
+    pb = timing.measure_phase_breakdown(
+        small_problem, mesh_shape=(2, 1, 1), fuse_steps=4,
+        scheme="compensated", iters=2, repeats=1,
+    )
+    assert pb.loop_seconds > 0.0
+    assert pb.exchange_seconds >= 0.0
+    assert pb.steps_measured == 8
+    pb_xy = timing.measure_phase_breakdown(
+        small_problem, mesh_shape=(2, 2, 1), fuse_steps=4,
+        scheme="compensated", iters=2, repeats=1,
+    )
+    assert pb_xy.loop_seconds > 0.0
+    pb_inc = timing.measure_phase_breakdown(
+        small_problem, mesh_shape=(2, 1, 1), fuse_steps=4,
+        scheme="compensated", v_dtype=jnp.bfloat16, iters=2, repeats=1,
+    )
+    assert pb_inc.loop_seconds > 0.0
+
+
 def test_phase_breakdown_kfused_rejects_3d_mesh(small_problem):
     with pytest.raises(ValueError, match=r"\(MX, MY, 1\)"):
         timing.measure_phase_breakdown(

@@ -25,7 +25,10 @@ pick at runtime):
   --phase-timing                    measure the loop vs ICI-exchange split
                                     (probe programs; see solver/timing.py) and
                                     add it to the report, like the reference's
-                                    "new" variants (mpi_new.cpp:368-371)
+                                    "new" variants (mpi_new.cpp:368-371);
+                                    covers the standard step and both k-fused
+                                    onions (incl. --scheme compensated with
+                                    --fuse-steps K)
   --scheme {standard,compensated}   time-integration scheme: compensated =
                                     Kahan incremental leapfrog, pushing f32
                                     to the discretization limit (5.7e-6 vs
@@ -52,9 +55,15 @@ pick at runtime):
                                     on the fundamental (N,N,N) grid
                                     (tau^2 applied internally).  Disables
                                     the analytic-error oracle (no closed
-                                    form); standard scheme, no --fuse-steps
-                                    (VMEM budget, solver/kfused.py scope
-                                    note); single or sharded backend
+                                    form).  Composes with --fuse-steps K
+                                    (the c^2tau^2 slab rides the k-step
+                                    onion as its own slab + k-plane halos)
+                                    and with --scheme compensated when
+                                    K >= 2 (the velocity-form onion takes
+                                    the field coefficient in the increment,
+                                    incl. --v-dtype bf16); single or
+                                    sharded backend, even or pad-and-mask
+                                    decompositions
   --kernel {auto,roll,pallas}       hot-kernel selection: pallas = the fused
                                     slab kernel (kernels/stencil_pallas.py,
                                     the analog of the reference shipping its
@@ -221,17 +230,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     "layers)"
                 )
         if "c2-field" in flags:
-            if scheme == "compensated":
+            if scheme == "compensated" and fuse_steps < 2:
                 raise ValueError(
-                    "--c2-field requires the standard scheme (the "
+                    "--c2-field with the compensated scheme rides the "
+                    "velocity-form onion: add --fuse-steps K (the 1-step "
                     "compensated kernels carry a scalar coefficient)"
-                )
-            if fuse_steps > 1:
-                raise ValueError(
-                    "--c2-field is not available with --fuse-steps: the "
-                    "field's own VMEM onion pushes every k >= 2 config "
-                    "over budget or to no-win block sizes "
-                    "(solver/kfused.py scope note)"
                 )
             if "phase-timing" in flags:
                 raise ValueError(
@@ -551,11 +554,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    "for a bf16 increment stream use --v-dtype bf16)")
         elif "overlap" in flags:
             bad = "--overlap"
-        elif "phase-timing" in flags:
-            # The probe (solver/timing.py) times the standard step;
-            # reporting its numbers against a compensated solve would
-            # describe a program that never ran.
-            bad = "--phase-timing"
+        elif "phase-timing" in flags and fuse_steps < 2:
+            # The velocity-form probe covers the k-fused program only
+            # (solver/timing.py); a 1-step compensated solve still has no
+            # probe, and reporting the standard step's numbers against it
+            # would describe a program that never ran.
+            bad = ("--phase-timing (the compensated probe covers "
+                   "--fuse-steps K programs; the 1-step scheme has none)")
+        elif "c2-field" in flags and fuse_steps < 2:
+            # Covers `--resume comp_ck --c2-field ...` without
+            # --fuse-steps: the scheme arrives from the checkpoint after
+            # the flag-level check, which only sees scheme == "standard".
+            bad = ("--c2-field without --fuse-steps K (the 1-step "
+                   "compensated kernels carry a scalar coefficient)")
         elif fuse_steps > 1 and (
             problem.N % _grid[0]
             or (problem.N // _grid[0]) % fuse_steps
@@ -629,6 +640,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 k=fuse_steps,
                 compute_errors=compute_errors,
                 v_dtype=jnp.bfloat16 if inc else None,
+                c2tau2_field=c2_field,
             )
             shape = _ck_mesh
         else:
@@ -643,6 +655,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 stop_step=stop_step,
                 v_dtype=jnp.bfloat16 if v_bf16 else None,
                 carry=not v_bf16,
+                c2tau2_field=c2_field,
             )
         n_procs = shape[0] * shape[1] * shape[2]
         variant = "TPU"
@@ -659,6 +672,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 dtype=resume_dtype,
                 k=fuse_steps,
                 compute_errors=compute_errors,
+                c2tau2_field=c2_field,
             )
             shape = _ck_mesh
         else:
@@ -670,6 +684,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 k=fuse_steps,
                 compute_errors=compute_errors,
                 stop_step=stop_step,
+                c2tau2_field=c2_field,
             )
         n_procs = shape[0] * shape[1] * shape[2]
         variant = "TPU"
@@ -760,6 +775,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     compute_errors=compute_errors,
                     interpret=interpret,
                     v_dtype=jnp.bfloat16 if inc else None,
+                    c2tau2_field=c2_field,
                 )
             elif scheme == "compensated":
                 comp_step_fn = None
@@ -796,6 +812,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     k=fuse_steps,
                     compute_errors=compute_errors,
                     interpret=interpret,
+                    c2tau2_field=c2_field,
                 )
             elif fuse_steps > 1:
                 from wavetpu.solver import kfused
@@ -809,6 +826,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     k=fuse_steps,
                     compute_errors=compute_errors,
                     interpret=interpret,
+                    c2tau2_field=c2_field,
                 )
             else:
                 result = leapfrog.resume(
@@ -833,6 +851,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 interpret=interpret,
                 v_dtype=jnp.bfloat16 if v_bf16 else None,
                 carry=not v_bf16,
+                c2tau2_field=c2_field,
             )
         elif scheme == "compensated":
             comp_step_fn = None
@@ -858,6 +877,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 compute_errors=compute_errors,
                 stop_step=stop_step,
                 interpret=interpret,
+                c2tau2_field=c2_field,
             )
         elif fuse_steps > 1:
             from wavetpu.solver import kfused
@@ -869,6 +889,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 compute_errors=compute_errors,
                 stop_step=stop_step,
                 interpret=interpret,
+                c2tau2_field=c2_field,
             )
         else:
             result = leapfrog.solve(
@@ -915,6 +936,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             kernel=kernel,
             overlap=overlap,
             fuse_steps=fuse_steps,
+            scheme=scheme,
+            v_dtype=(
+                jnp.bfloat16 if flags.get("v-dtype") == "bf16" else None
+            ),
         )
         exchange_seconds, loop_seconds = pb.exchange_seconds, pb.loop_seconds
         probe_steps = pb.steps_measured

@@ -1,0 +1,31 @@
+"""Version shims for the jax API surface this codebase is written against.
+
+The solvers target the current jax names (`jax.shard_map` with its
+`check_vma` flag, `pltpu.CompilerParams`); older jaxlib images ship the
+same functionality under the earlier names (`jax.experimental.shard_map`
+with `check_rep`, `pltpu.TPUCompilerParams`).  Resolving the names once
+here keeps every kernel/solver module version-agnostic without scattering
+try/except at the call sites.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.experimental.pallas import tpu as _pltpu
+
+CompilerParams = getattr(
+    _pltpu, "CompilerParams", getattr(_pltpu, "TPUCompilerParams", None)
+)
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma=True):
+        """`jax.shard_map` signature on the pre-unification API (where the
+        varying-manual-axes check was called check_rep)."""
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma,
+        )

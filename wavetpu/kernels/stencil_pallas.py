@@ -43,6 +43,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from wavetpu.core.problem import Problem
+from wavetpu import compat
 from wavetpu.kernels import stencil_ref
 
 # Per-core VMEM working-set budget (bytes) used to pick block_x: the
@@ -145,14 +146,18 @@ def _step_kernel(uprev_ref, uc_ref, ulo_ref, uhi_ref, out_ref,
 
 def _var_step_kernel(c2_ref, uprev_ref, uc_ref, ulo_ref, uhi_ref, out_ref,
                      *, inv_h2, compute_dtype):
-    """Variable-speed leapfrog slab: out = 2u - u_prev + tau^2 c^2(x) lap(u).
+    """Variable-speed leapfrog slab: out = 2u + tau^2 c^2(x) lap(u) - u_prev.
 
     The c^2 tau^2 field rides its own slab input - the capability extension
-    over the reference's hardcoded __constant__ a2 (cuda_sol_kernels.cu:3)."""
+    over the reference's hardcoded __constant__ a2 (cuda_sol_kernels.cu:3).
+    The summation order (2u + coeff*lap) - u_prev matches `_sharded_kernel`'s
+    field path and the k-step onion's variable-c substep, so variable-c
+    layers are op-identical across the 1-step, sharded, and k-fused paths
+    (the same bitwise-mixing contract as the constant-c kernels)."""
     f = compute_dtype
     c = uc_ref[:].astype(f)
     lap = _slab_laplacian(c, ulo_ref, uhi_ref, inv_h2, f)
-    u_next = 2.0 * c - uprev_ref[:].astype(f) + c2_ref[:].astype(f) * lap
+    u_next = 2.0 * c + c2_ref[:].astype(f) * lap - uprev_ref[:].astype(f)
     _finish_update(u_next, out_ref, f)
 
 
@@ -207,7 +212,7 @@ def _fused_step(u_prev, u, *, inv_h2, alpha=2.0, beta=1.0, coeff=None,
         in_specs=in_specs,
         out_specs=slab,
         out_shape=jax.ShapeDtypeStruct(u.shape, u.dtype),
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=compat.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )(*operands)
 
@@ -492,7 +497,7 @@ def sharded_fused_step(u_prev, u, ghosts, offsets, n_global, *, inv_h2,
         in_specs=in_specs,
         out_specs=slab,
         out_shape=_out_struct(u),
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=compat.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )(*operands)
 
@@ -531,7 +536,7 @@ def sharded_compensated_step(u, v, carry, ghosts, offsets, n_global, *,
         in_specs=in_specs,
         out_specs=[slab, slab, slab],
         out_shape=[out, out, out],
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=compat.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )(*operands)
 
@@ -593,7 +598,7 @@ def compensated_step(u, v, carry, problem: Problem, coeff=None, *,
         in_specs=[slab, slab, slab, lo, hi],
         out_specs=[slab, slab, slab],
         out_shape=[out, out, out],
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=compat.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )(v, carry, u, u, u)
 
@@ -630,6 +635,16 @@ def make_compensated_step_fn(block_x=None, interpret=False):
 # is a TPU-first redesign enabled by the 128 MB VMEM and the sequential
 # pallas grid.
 #
+# Variable c(x, y, z) rides the onion too (round 6): the c^2tau^2 field
+# is time-invariant, so it enters as ONE onion-extent operand (slab +
+# k-plane halos; ghost-overridden at shard edges like the state) and each
+# substep s multiplies the Laplacian by the static slice C2[s : L0 - s] -
+# the planes the shrinking update still writes.  Same summation order as
+# the 1-step `_var_step_kernel`, so variable-c layers keep the bitwise
+# mixing contract.  The field onion costs (bx + 2k) extra f32 planes in
+# the pipeline plus one onion temp, which is what caps the block choice
+# (`choose_kstep_block(field=True)`).
+#
 # Per-layer L-inf errors stay EXACTLY as observable as the reference's
 # (mpi_new.cpp:335-345) even though intermediate layers never reach HBM:
 # the analytic solution is separable (verify/oracle.py), so
@@ -654,6 +669,7 @@ _KSTEP_COMP_VMEM_LIMIT = int(127.9 * 1024 * 1024)
 def choose_kstep_block(
     n: int, k: int, itemsize: int = 4, depth: Optional[int] = None,
     ghosts: bool = False, plane_elems: Optional[int] = None,
+    field: bool = False,
 ) -> Optional[int]:
     """Largest slab depth bx (multiple of k, power-of-two steps, <= 8,
     dividing `depth`) whose k-step pipeline fits VMEM; None if even bx=k
@@ -666,6 +682,15 @@ def choose_kstep_block(
     pipeline holds 2 state slabs in + 4 k-plane halos + 2 slabs out, the
     kernel body another ~3 onion-sized f32 temporaries, plus the two
     (N,N) oracle planes.
+
+    `field=True` adds the variable-c working set: the c^2tau^2 onion rides
+    as its own slab + k-plane halo fetch (f32 - the COMPUTE width, like the
+    1-step field slab) plus one onion-sized concat temp in the body.  At
+    N=512 f32 that admits k=2/bx=4 under the calibrated budget; k=4/bx=4
+    models at ~134 MB against the 128 MiB physical - outside what this
+    model will bless, but close enough to the measured ~5% overestimate
+    that `block_x=4` stays exposed for explicit on-chip attempts
+    (bench.py's kfused_varc row tries it and records the outcome).
     """
     if depth is None:
         depth = n
@@ -682,15 +707,43 @@ def choose_kstep_block(
                 pipeline += 4 * k * pb_state
             planes = 4 * pb_f32
             temps = 3 * (bx + 2 * k) * pb_f32
+            if field:
+                pipeline += 2 * (bx + 2 * k) * pb_f32
+                if ghosts:
+                    pipeline += 2 * k * pb_f32
+                temps += (bx + 2 * k) * pb_f32
             if pipeline + planes + temps <= _KSTEP_VMEM_BUDGET:
                 best = bx
         bx *= 2
     return best
 
 
-def _kstep_kernel(sxct_ref, uprev_ref, uc_ref, plo_ref, phi_ref, lo_ref,
-                  hi_ref, syz_ref, rsyz_ref, *out_refs,
-                  k, bx, coeff, inv_h2, compute_dtype, with_errors):
+def _field_onion(it, f, has_field):
+    """Assemble the c^2tau^2 onion from the next three refs (slab + the two
+    k-plane wraparound halos) when a field rides this call; None otherwise.
+
+    The field is time-invariant, so unlike prev/cur its onion never
+    shrinks: substep s reads the static slice C2[s : L0 - s] (the planes
+    the shrinking update still writes).
+    """
+    if not has_field:
+        return None
+    c2_ref, c2lo_ref, c2hi_ref = next(it), next(it), next(it)
+    return jnp.concatenate(
+        [c2lo_ref[:].astype(f), c2_ref[:].astype(f), c2hi_ref[:].astype(f)],
+        0)
+
+
+def _substep_coeff(c2_onion, coeff, s, f):
+    """Per-substep Laplacian coefficient: the matching field-onion slice,
+    or the scalar a^2tau^2."""
+    if c2_onion is None:
+        return jnp.asarray(coeff, f)
+    return c2_onion[s: c2_onion.shape[0] - s]
+
+
+def _kstep_kernel(*refs, k, bx, coeff, inv_h2, compute_dtype, with_errors,
+                  has_field=False):
     """March k leapfrog substeps on a slab onion held in VMEM.
 
     The prev/cur onions start at bx+2k planes (slab + k-plane wraparound
@@ -701,15 +754,29 @@ def _kstep_kernel(sxct_ref, uprev_ref, uc_ref, plo_ref, phi_ref, lo_ref,
     identical to the 1-step pallas solve and the two can be mixed freely
     across checkpoint/resume boundaries (tests/test_kfused.py).
 
+    With `has_field` the c^2tau^2 onion rides three extra input refs and
+    each substep multiplies the Laplacian by its slice of the field
+    instead of the scalar coefficient - the same summation order as the
+    1-step `_var_step_kernel`, so variable-c layers keep the bitwise
+    mixing contract (tests/test_kfused_varc.py).
+
     With `with_errors`, per-substep per-x-plane error maxes are stored as
     SMEM scalars (see the section comment for the factorization).
     """
+    it = iter(refs)
+    sxct_ref = next(it)
+    f = compute_dtype
+    c2_onion = _field_onion(it, f, has_field)
+    uprev_ref, uc_ref = next(it), next(it)
+    plo_ref, phi_ref = next(it), next(it)
+    lo_ref, hi_ref = next(it), next(it)
+    syz_ref, rsyz_ref = next(it), next(it)
+    out_refs = list(it)
     if with_errors:
         out_prev_ref, out_ref, dmax_ref, rmax_ref = out_refs
     else:
         out_prev_ref, out_ref = out_refs
     i = pl.program_id(0)
-    f = compute_dtype
     ix, iy, iz = (jnp.asarray(v, f) for v in inv_h2)
     prev = jnp.concatenate(
         [plo_ref[:].astype(f), uprev_ref[:].astype(f), phi_ref[:].astype(f)],
@@ -733,7 +800,8 @@ def _kstep_kernel(sxct_ref, uprev_ref, uc_ref, plo_ref, phi_ref, lo_ref,
         lap = lap + (
             pltpu.roll(c, 1, 2) + pltpu.roll(c, nz - 1, 2) - 2.0 * c
         ) * iz
-        new = 2.0 * c + jnp.asarray(coeff, f) * lap - prev[1:-1]
+        new = 2.0 * c + _substep_coeff(c2_onion, coeff, s, f) * lap \
+            - prev[1:-1]
         new = jnp.where(mask, new, jnp.asarray(0.0, f))
         if out_ref.dtype != f:
             # A narrower state dtype (bf16) quantizes every stored layer on
@@ -754,8 +822,8 @@ def _kstep_kernel(sxct_ref, uprev_ref, uc_ref, plo_ref, phi_ref, lo_ref,
 
 
 def fused_kstep(u_prev, u, syz, rsyz, sxct, *, k, coeff, inv_h2,
-                block_x=None, interpret=False, with_errors=True,
-                compute_dtype=None):
+                c2tau2_field=None, block_x=None, interpret=False,
+                with_errors=True, compute_dtype=None):
     """k temporally fused leapfrog steps of the full (N,N,N) state.
 
     Returns `(u_{n+k-1}, u_{n+k}, dmax, rmax)` where dmax/rmax are (k, N)
@@ -763,13 +831,21 @@ def fused_kstep(u_prev, u, syz, rsyz, sxct, *, k, coeff, inv_h2,
     `syz`/`rsyz` are the (N, N) oracle planes sy*sz and 1/|sy*sz| (0 at 0);
     `sxct` the (k, N) per-substep sx*ct row (any (k, N) f32 array when
     errors are off).  Requires N % k == 0 (wraparound halo blocks).
+
+    With `c2tau2_field` (an (N,N,N) tau^2 c^2(x,y,z) array) the variable-c
+    substep runs and `coeff` is ignored; the field rides its own slab +
+    k-plane wraparound halos, matching the state onions' x extent.  Pair
+    it with with_errors=False (the analytic oracle is constant-c only).
     """
     n = u.shape[0]
     if compute_dtype is None:
         compute_dtype = stencil_ref.compute_dtype(u.dtype)
     if n % k:
         raise ValueError(f"k={k} must divide N={n}")
-    bx = block_x or choose_kstep_block(n, k, u.dtype.itemsize)
+    has_field = c2tau2_field is not None
+    bx = block_x or choose_kstep_block(
+        n, k, u.dtype.itemsize, field=has_field
+    )
     if bx is None:
         raise ValueError(
             f"k={k} does not fit VMEM at N={n} (choose_kstep_block)"
@@ -796,7 +872,16 @@ def fused_kstep(u_prev, u, syz, rsyz, sxct, *, k, coeff, inv_h2,
     kern = functools.partial(
         _kstep_kernel, k=k, bx=bx, coeff=coeff, inv_h2=inv_h2,
         compute_dtype=compute_dtype, with_errors=with_errors,
+        has_field=has_field,
     )
+    in_specs = [smem]
+    operands = [sxct]
+    if has_field:
+        fld = jnp.asarray(c2tau2_field, dtype=compute_dtype)
+        in_specs += [slab, lo, hi]
+        operands += [fld, fld, fld]
+    in_specs += [slab, slab, lo, hi, lo, hi, plane, plane]
+    operands += [u_prev, u, u_prev, u_prev, u, u, syz, rsyz]
     state = jax.ShapeDtypeStruct(u.shape, u.dtype)
     out_specs = [slab, slab]
     out_shape = [state, state]
@@ -806,14 +891,14 @@ def fused_kstep(u_prev, u, syz, rsyz, sxct, *, k, coeff, inv_h2,
     out = pl.pallas_call(
         kern,
         grid=(n // bx,),
-        in_specs=[smem, slab, slab, lo, hi, lo, hi, plane, plane],
+        in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.CompilerParams(
             vmem_limit_bytes=_KSTEP_VMEM_LIMIT
         ),
         interpret=interpret,
-    )(sxct, u_prev, u, u_prev, u_prev, u, u, syz, rsyz)
+    )(*operands)
     if with_errors:
         return out
     return out[0], out[1], None, None
@@ -823,6 +908,7 @@ def choose_kstep_comp_block(
     n: int, k: int, u_itemsize: int = 4, v_itemsize: int = 4,
     carry_itemsize: Optional[int] = 4, depth: Optional[int] = None,
     ghosts: bool = False, plane_elems: Optional[int] = None,
+    field: bool = False,
 ) -> Optional[int]:
     """Slab depth for the compensated/velocity-form k-step kernel.
 
@@ -844,6 +930,12 @@ def choose_kstep_comp_block(
     ~1.25x the naive 2*k*state estimate - Mosaic double-buffers part of
     the constant-index fetches).  At N=512 that correctly rejects k=4
     for the sharded comp kernel (148.6 MB measured > 128); k=2 fits.
+
+    `field=True` adds the variable-c onion (f32 slab + k-plane halos in
+    the pipeline, one onion concat temp in the body; ghost fetches carry
+    the same 1.25x factor as the state ghosts).  At N=512 the carry-less
+    f32+bf16 increment form then fits k=2 (bx=4); k=4 models over the
+    ceiling, as for the standard field onion (`choose_kstep_block`).
     """
     if depth is None:
         depth = n
@@ -864,6 +956,11 @@ def choose_kstep_comp_block(
                 pipeline += 5 * k * state * plane_elems // 2
             planes = 4 * pb_f32
             temps = (315 if has_carry else 340) * onion * pb_f32 // 100
+            if field:
+                pipeline += 2 * onion * pb_f32
+                if ghosts:
+                    pipeline += 5 * k * pb_f32 // 2
+                temps += onion * pb_f32
             if pipeline + planes + temps <= _KSTEP_COMP_VMEM_LIMIT:
                 best = bx
         bx *= 2
@@ -871,7 +968,7 @@ def choose_kstep_comp_block(
 
 
 def _kstep_comp_kernel(*refs, k, bx, coeff, inv_h2, compute_dtype,
-                       with_errors, has_carry):
+                       with_errors, has_carry, has_field=False):
     """March k compensated (velocity-form) leapfrog substeps on a VMEM
     slab onion.
 
@@ -892,6 +989,11 @@ def _kstep_comp_kernel(*refs, k, bx, coeff, inv_h2, compute_dtype,
     the mode for a bf16 increment stream, where bf16 quantization of v
     dwarfs what a carry would recover.
 
+    `has_field` threads the c^2tau^2 onion through the increment:
+    v' = v + c^2tau^2(x,y,z)*lap(u) - the field coefficient enters the
+    velocity form at exactly one multiply, so variable-c composes with
+    the carry AND the bf16-increment mode unchanged.
+
     No bitwise parity with the 1-step path is claimed (unlike
     `_kstep_kernel`): intermediate layers skip the storage-dtype
     round-trip and halo carries differ - the contract is tolerance parity
@@ -899,6 +1001,7 @@ def _kstep_comp_kernel(*refs, k, bx, coeff, inv_h2, compute_dtype,
     """
     it = iter(refs)
     sxct_ref = next(it)
+    c2_onion = _field_onion(it, compute_dtype, has_field)
     u_ref, ulo_ref, uhi_ref = next(it), next(it), next(it)
     v_ref, vlo_ref, vhi_ref = next(it), next(it), next(it)
     carry_ref = next(it) if has_carry else None
@@ -937,7 +1040,7 @@ def _kstep_comp_kernel(*refs, k, bx, coeff, inv_h2, compute_dtype,
         lap = lap + (
             pltpu.roll(uc, 1, 2) + pltpu.roll(uc, nz - 1, 2) - 2.0 * uc
         ) * iz
-        d = jnp.where(mask, jnp.asarray(coeff, f) * lap,
+        d = jnp.where(mask, _substep_coeff(c2_onion, coeff, s, f) * lap,
                       jnp.asarray(0.0, f))
         vn = V[1:-1] + d
         if has_carry:
@@ -966,8 +1069,8 @@ def _kstep_comp_kernel(*refs, k, bx, coeff, inv_h2, compute_dtype,
 
 
 def fused_kstep_comp(u, v, carry, syz, rsyz, sxct, *, k, coeff, inv_h2,
-                     block_x=None, interpret=False, with_errors=True,
-                     compute_dtype=None):
+                     c2tau2_field=None, block_x=None, interpret=False,
+                     with_errors=True, compute_dtype=None):
     """k temporally fused compensated (velocity-form) leapfrog steps.
 
     State is `(u_n, v_n = u_n - u_{n-1}, carry_n)` as in
@@ -976,6 +1079,10 @@ def fused_kstep_comp(u, v, carry, syz, rsyz, sxct, *, k, coeff, inv_h2,
     storage dtype; compute is f32.  Returns `(u_{n+k}, v_{n+k},
     carry_{n+k} | None, dmax, rmax)` with the same (k, N) per-substep
     per-x-plane error rows as `fused_kstep`.  Requires N % k == 0.
+
+    With `c2tau2_field` the increment uses the spatially varying
+    coefficient (v' = v + c^2tau^2(x)*lap(u)) and `coeff` is ignored;
+    pair it with with_errors=False (no analytic oracle).
     """
     n = u.shape[0]
     if compute_dtype is None:
@@ -983,9 +1090,10 @@ def fused_kstep_comp(u, v, carry, syz, rsyz, sxct, *, k, coeff, inv_h2,
     if n % k:
         raise ValueError(f"k={k} must divide N={n}")
     has_carry = carry is not None
+    has_field = c2tau2_field is not None
     bx = block_x or choose_kstep_comp_block(
         n, k, u.dtype.itemsize, v.dtype.itemsize,
-        carry.dtype.itemsize if has_carry else None,
+        carry.dtype.itemsize if has_carry else None, field=has_field,
     )
     if bx is None:
         raise ValueError(
@@ -1010,10 +1118,16 @@ def fused_kstep_comp(u, v, carry, syz, rsyz, sxct, *, k, coeff, inv_h2,
     kern = functools.partial(
         _kstep_comp_kernel, k=k, bx=bx, coeff=coeff, inv_h2=inv_h2,
         compute_dtype=compute_dtype, with_errors=with_errors,
-        has_carry=has_carry,
+        has_carry=has_carry, has_field=has_field,
     )
-    in_specs = [smem, slab, lo, hi, slab, lo, hi]
-    operands = [sxct, u, u, u, v, v, v]
+    in_specs = [smem]
+    operands = [sxct]
+    if has_field:
+        fld = jnp.asarray(c2tau2_field, dtype=compute_dtype)
+        in_specs += [slab, lo, hi]
+        operands += [fld, fld, fld]
+    in_specs += [slab, lo, hi, slab, lo, hi]
+    operands += [u, u, u, v, v, v]
     if has_carry:
         in_specs.append(slab)
         operands.append(carry)
@@ -1034,7 +1148,7 @@ def fused_kstep_comp(u, v, carry, syz, rsyz, sxct, *, k, coeff, inv_h2,
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.CompilerParams(
             vmem_limit_bytes=_KSTEP_COMP_VMEM_LIMIT
         ),
         interpret=interpret,
@@ -1047,7 +1161,8 @@ def fused_kstep_comp(u, v, carry, syz, rsyz, sxct, *, k, coeff, inv_h2,
 
 
 def _kstep_comp_sharded_kernel(*refs, k, bx, coeff, inv_h2,
-                               compute_dtype, with_errors, has_carry):
+                               compute_dtype, with_errors, has_carry,
+                               has_field=False):
     """`_kstep_comp_kernel` for an x-sharded block: the k-plane u/v halos
     of the block's EDGE programs come from ppermute'd ghost operands
     instead of the in-block wraparound (the `pick` of
@@ -1062,6 +1177,9 @@ def _kstep_comp_sharded_kernel(*refs, k, bx, coeff, inv_h2,
     contract."""
     it = iter(refs)
     sxct_ref = next(it)
+    c2_refs = (
+        [next(it) for _ in range(5)] if has_field else None
+    )
     u_ref, ulo_ref, uhi_ref = next(it), next(it), next(it)
     uglo_ref, ughi_ref = next(it), next(it)
     v_ref, vlo_ref, vhi_ref = next(it), next(it), next(it)
@@ -1085,6 +1203,8 @@ def _kstep_comp_sharded_kernel(*refs, k, bx, coeff, inv_h2,
             at_edge, ghost_ref[:].astype(f), wrap_ref[:].astype(f)
         )
 
+    c2_onion = _sharded_field_onion(iter(c2_refs), pick, f, has_field) \
+        if has_field else None
     U = jnp.concatenate([
         pick(True, uglo_ref, ulo_ref),
         u_ref[:].astype(f),
@@ -1115,7 +1235,7 @@ def _kstep_comp_sharded_kernel(*refs, k, bx, coeff, inv_h2,
         lap = lap + (
             pltpu.roll(uc, 1, 2) + pltpu.roll(uc, nz - 1, 2) - 2.0 * uc
         ) * iz
-        d = jnp.where(mask, jnp.asarray(coeff, f) * lap,
+        d = jnp.where(mask, _substep_coeff(c2_onion, coeff, s, f) * lap,
                       jnp.asarray(0.0, f))
         vn = V[1:-1] + d
         if has_carry:
@@ -1142,7 +1262,8 @@ def _kstep_comp_sharded_kernel(*refs, k, bx, coeff, inv_h2,
 
 
 def fused_kstep_comp_sharded(u, v, carry, u_ghosts, v_ghosts, syz, rsyz,
-                             sxct, *, k, coeff, inv_h2, block_x=None,
+                             sxct, *, k, coeff, inv_h2, c2tau2_block=None,
+                             c2_ghosts=None, block_x=None,
                              interpret=False, with_errors=True,
                              compute_dtype=None):
     """k fused compensated (velocity-form) leapfrog steps of one
@@ -1155,6 +1276,10 @@ def fused_kstep_comp_sharded(u, v, carry, u_ghosts, v_ghosts, syz, rsyz,
     `fused_kstep_sharded`.  `sxct` is this shard's (k, N/P) oracle row
     slice.  Returns `(u', v', carry'|None, dmax, rmax)` with (k, N/P)
     local error rows.
+
+    `c2tau2_block`/`c2_ghosts` thread this shard's tau^2 c^2 slice (and
+    its once-per-solve k-plane ghost pair) through the increment, as
+    `fused_kstep_sharded`.
     """
     nl = u.shape[0]
     if compute_dtype is None:
@@ -1162,10 +1287,11 @@ def fused_kstep_comp_sharded(u, v, carry, u_ghosts, v_ghosts, syz, rsyz,
     if nl % k:
         raise ValueError(f"k={k} must divide the shard depth {nl}")
     has_carry = carry is not None
+    has_field = c2tau2_block is not None
     bx = block_x or choose_kstep_comp_block(
         u.shape[1], k, u.dtype.itemsize, v.dtype.itemsize,
         carry.dtype.itemsize if has_carry else None,
-        depth=nl, ghosts=True,
+        depth=nl, ghosts=True, field=has_field,
     )
     if bx is None:
         raise ValueError(
@@ -1196,11 +1322,18 @@ def fused_kstep_comp_sharded(u, v, carry, u_ghosts, v_ghosts, syz, rsyz,
         _kstep_comp_sharded_kernel, k=k, bx=bx, coeff=coeff,
         inv_h2=inv_h2, compute_dtype=compute_dtype,
         with_errors=with_errors, has_carry=has_carry,
+        has_field=has_field,
     )
-    in_specs = [smem, slab, lo, hi, ghost, ghost,
-                slab, lo, hi, ghost, ghost]
-    operands = [sxct, u, u, u, u_ghosts[0], u_ghosts[1],
-                v, v, v, v_ghosts[0], v_ghosts[1]]
+    in_specs = [smem]
+    operands = [sxct]
+    if has_field:
+        fld = jnp.asarray(c2tau2_block, dtype=compute_dtype)
+        in_specs += [slab, lo, hi, ghost, ghost]
+        operands += [fld, fld, fld, c2_ghosts[0], c2_ghosts[1]]
+    in_specs += [slab, lo, hi, ghost, ghost,
+                 slab, lo, hi, ghost, ghost]
+    operands += [u, u, u, u_ghosts[0], u_ghosts[1],
+                 v, v, v, v_ghosts[0], v_ghosts[1]]
     if has_carry:
         in_specs.append(slab)
         operands.append(carry)
@@ -1221,7 +1354,7 @@ def fused_kstep_comp_sharded(u, v, carry, u_ghosts, v_ghosts, syz, rsyz,
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.CompilerParams(
             vmem_limit_bytes=_KSTEP_COMP_VMEM_LIMIT
         ),
         interpret=interpret,
@@ -1235,7 +1368,7 @@ def fused_kstep_comp_sharded(u, v, carry, u_ghosts, v_ghosts, syz, rsyz,
 
 def _kstep_comp_sharded_xy_kernel(*refs, k, bx, nl_y, n_global, coeff,
                                   inv_h2, compute_dtype, with_errors,
-                                  has_carry):
+                                  has_carry, has_field=False):
     """`_kstep_comp_sharded_kernel` for blocks ALSO sharded along y.
 
     u and v arrive pre-extended with k ghost ROWS per side (width
@@ -1251,6 +1384,9 @@ def _kstep_comp_sharded_xy_kernel(*refs, k, bx, nl_y, n_global, coeff,
     it = iter(refs)
     y0_ref = next(it)
     sxct_ref = next(it)
+    c2_refs = (
+        [next(it) for _ in range(5)] if has_field else None
+    )
     u_ref, ulo_ref, uhi_ref = next(it), next(it), next(it)
     uglo_ref, ughi_ref = next(it), next(it)
     v_ref, vlo_ref, vhi_ref = next(it), next(it), next(it)
@@ -1274,6 +1410,8 @@ def _kstep_comp_sharded_xy_kernel(*refs, k, bx, nl_y, n_global, coeff,
             at_edge, ghost_ref[:].astype(f), wrap_ref[:].astype(f)
         )
 
+    c2_onion = _sharded_field_onion(iter(c2_refs), pick, f, has_field) \
+        if has_field else None
     U = jnp.concatenate([
         pick(True, uglo_ref, ulo_ref),
         u_ref[:].astype(f),
@@ -1309,7 +1447,7 @@ def _kstep_comp_sharded_xy_kernel(*refs, k, bx, nl_y, n_global, coeff,
         lap = lap + (
             pltpu.roll(uc, 1, 2) + pltpu.roll(uc, nz - 1, 2) - 2.0 * uc
         ) * iz
-        d = jnp.where(mask, jnp.asarray(coeff, f) * lap,
+        d = jnp.where(mask, _substep_coeff(c2_onion, coeff, s, f) * lap,
                       jnp.asarray(0.0, f))
         vn = V[1:-1] + d
         if has_carry:
@@ -1339,7 +1477,8 @@ def _kstep_comp_sharded_xy_kernel(*refs, k, bx, nl_y, n_global, coeff,
 
 def fused_kstep_comp_sharded_xy(u_ext, v_ext, carry, u_ghosts, v_ghosts,
                                 syz_c, rsyz_c, sxct, y0, n_global, *,
-                                k, nl_y, coeff, inv_h2, block_x=None,
+                                k, nl_y, coeff, inv_h2, c2tau2_ext=None,
+                                c2_ghosts=None, block_x=None,
                                 interpret=False, with_errors=True,
                                 compute_dtype=None):
     """k fused compensated (velocity-form) steps of an (x, y)-sharded
@@ -1354,6 +1493,10 @@ def fused_kstep_comp_sharded_xy(u_ext, v_ext, carry, u_ghosts, v_ghosts,
     shard's y range; callers pmax over the y axis).  y-sharding shrinks
     every VMEM plane by Q, which is what lets k=4 fit at N=512 where
     the x-only variant is VMEM-bound at k=2.
+
+    `c2tau2_ext`/`c2_ghosts` thread the y-extended field block and its
+    once-per-solve x-ghost pair through the increment
+    (`fused_kstep_sharded_xy` semantics).
     """
     nl_x, w, nz = u_ext.shape
     if compute_dtype is None:
@@ -1365,10 +1508,11 @@ def fused_kstep_comp_sharded_xy(u_ext, v_ext, carry, u_ghosts, v_ghosts,
     if nl_x % k:
         raise ValueError(f"k={k} must divide the shard depth {nl_x}")
     has_carry = carry is not None
+    has_field = c2tau2_ext is not None
     bx = block_x or choose_kstep_comp_block(
         nz, k, u_ext.dtype.itemsize, v_ext.dtype.itemsize,
         carry.dtype.itemsize if has_carry else None,
-        depth=nl_x, ghosts=True, plane_elems=w * nz,
+        depth=nl_x, ghosts=True, plane_elems=w * nz, field=has_field,
     )
     if bx is None:
         raise ValueError(
@@ -1399,13 +1543,18 @@ def fused_kstep_comp_sharded_xy(u_ext, v_ext, carry, u_ghosts, v_ghosts,
         _kstep_comp_sharded_xy_kernel, k=k, bx=bx, nl_y=nl_y,
         n_global=n_global, coeff=coeff, inv_h2=inv_h2,
         compute_dtype=compute_dtype, with_errors=with_errors,
-        has_carry=has_carry,
+        has_carry=has_carry, has_field=has_field,
     )
-    in_specs = [smem, smem, slab, lo, hi, ghost, ghost,
-                slab, lo, hi, ghost, ghost]
-    operands = [jnp.asarray(y0, jnp.int32).reshape(1), sxct,
-                u_ext, u_ext, u_ext, u_ghosts[0], u_ghosts[1],
-                v_ext, v_ext, v_ext, v_ghosts[0], v_ghosts[1]]
+    in_specs = [smem, smem]
+    operands = [jnp.asarray(y0, jnp.int32).reshape(1), sxct]
+    if has_field:
+        fld = jnp.asarray(c2tau2_ext, dtype=compute_dtype)
+        in_specs += [slab, lo, hi, ghost, ghost]
+        operands += [fld, fld, fld, c2_ghosts[0], c2_ghosts[1]]
+    in_specs += [slab, lo, hi, ghost, ghost,
+                 slab, lo, hi, ghost, ghost]
+    operands += [u_ext, u_ext, u_ext, u_ghosts[0], u_ghosts[1],
+                 v_ext, v_ext, v_ext, v_ghosts[0], v_ghosts[1]]
     if has_carry:
         in_specs.append(cslab)
         operands.append(carry)
@@ -1429,7 +1578,7 @@ def fused_kstep_comp_sharded_xy(u_ext, v_ext, carry, u_ghosts, v_ghosts,
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.CompilerParams(
             vmem_limit_bytes=_KSTEP_COMP_VMEM_LIMIT
         ),
         interpret=interpret,
@@ -1441,21 +1590,35 @@ def fused_kstep_comp_sharded_xy(u_ext, v_ext, carry, u_ghosts, v_ghosts,
     return u_o, v_o, c_o, None, None
 
 
-def _kstep_sharded_kernel(sxct_ref, uprev_ref, uc_ref, plo_ref, phi_ref,
-                          lo_ref, hi_ref, pglo_ref, pghi_ref, glo_ref,
-                          ghi_ref, syz_ref, rsyz_ref, *out_refs,
-                          k, bx, coeff, inv_h2, compute_dtype, with_errors):
+def _sharded_field_onion(it, pick, f, has_field):
+    """Assemble the c^2tau^2 onion for a sharded onion kernel from the
+    next five refs (slab, wraparound lo/hi, ghost lo/hi), with the edge
+    programs' halos ghost-overridden exactly like the state onions."""
+    if not has_field:
+        return None
+    c2_ref = next(it)
+    c2lo_ref, c2hi_ref = next(it), next(it)
+    c2glo_ref, c2ghi_ref = next(it), next(it)
+    return jnp.concatenate([
+        pick(True, c2glo_ref, c2lo_ref),
+        c2_ref[:].astype(f),
+        pick(False, c2ghi_ref, c2hi_ref),
+    ], 0)
+
+
+def _kstep_sharded_kernel(*refs, k, bx, coeff, inv_h2, compute_dtype,
+                          with_errors, has_field=False):
     """`_kstep_kernel` for an x-sharded block: the k-plane halos of the
     block's EDGE programs come from the ppermute'd ghost operands (the
     neighbouring shard's boundary planes) instead of the in-block
     wraparound - interior programs are untouched, so a 1-shard mesh
     compiles to the single-device onion's data path.  y/z stay full-domain
     per shard (x-only decomposition), so the in-VMEM rolls and the fused
-    Dirichlet mask are exactly the single-device kernel's."""
-    if with_errors:
-        out_prev_ref, out_ref, dmax_ref, rmax_ref = out_refs
-    else:
-        out_prev_ref, out_ref = out_refs
+    Dirichlet mask are exactly the single-device kernel's.  `has_field`
+    adds the c^2tau^2 onion (slab + wraparound halos + edge ghosts) as in
+    `_kstep_kernel`."""
+    it = iter(refs)
+    sxct_ref = next(it)
     i = pl.program_id(0)
     last = pl.num_programs(0) - 1
     f = compute_dtype
@@ -1466,6 +1629,19 @@ def _kstep_sharded_kernel(sxct_ref, uprev_ref, uc_ref, plo_ref, phi_ref,
         return jnp.where(
             at_edge, ghost_ref[:].astype(f), wrap_ref[:].astype(f)
         )
+
+    c2_onion = _sharded_field_onion(it, pick, f, has_field)
+    uprev_ref, uc_ref = next(it), next(it)
+    plo_ref, phi_ref = next(it), next(it)
+    lo_ref, hi_ref = next(it), next(it)
+    pglo_ref, pghi_ref = next(it), next(it)
+    glo_ref, ghi_ref = next(it), next(it)
+    syz_ref, rsyz_ref = next(it), next(it)
+    out_refs = list(it)
+    if with_errors:
+        out_prev_ref, out_ref, dmax_ref, rmax_ref = out_refs
+    else:
+        out_prev_ref, out_ref = out_refs
 
     prev = jnp.concatenate([
         pick(True, pglo_ref, plo_ref),
@@ -1494,7 +1670,8 @@ def _kstep_sharded_kernel(sxct_ref, uprev_ref, uc_ref, plo_ref, phi_ref,
         lap = lap + (
             pltpu.roll(c, 1, 2) + pltpu.roll(c, nz - 1, 2) - 2.0 * c
         ) * iz
-        new = 2.0 * c + jnp.asarray(coeff, f) * lap - prev[1:-1]
+        new = 2.0 * c + _substep_coeff(c2_onion, coeff, s, f) * lap \
+            - prev[1:-1]
         new = jnp.where(mask, new, jnp.asarray(0.0, f))
         if out_ref.dtype != f:
             new = new.astype(out_ref.dtype).astype(f)
@@ -1511,7 +1688,8 @@ def _kstep_sharded_kernel(sxct_ref, uprev_ref, uc_ref, plo_ref, phi_ref,
 
 
 def fused_kstep_sharded(u_prev, u, prev_ghosts, cur_ghosts, syz, rsyz, sxct,
-                        *, k, coeff, inv_h2, block_x=None, interpret=False,
+                        *, k, coeff, inv_h2, c2tau2_block=None,
+                        c2_ghosts=None, block_x=None, interpret=False,
                         with_errors=True, compute_dtype=None):
     """k temporally fused leapfrog steps of one x-sharded block.
 
@@ -1522,14 +1700,21 @@ def fused_kstep_sharded(u_prev, u, prev_ghosts, cur_ghosts, syz, rsyz, sxct,
     mpi_new.cpp:327-352, with the exchange amortized over k layers).
     `sxct` is this shard's (k, N/P) oracle row slice.  Returns the same
     tuple as `fused_kstep` with (k, N/P)-local error rows.
+
+    With `c2tau2_block` (this shard's tau^2 c^2 slice) and `c2_ghosts`
+    (its (lo, hi) k-plane ghost pair - the field is time-invariant, so the
+    solver exchanges these ONCE per solve, not per block) the variable-c
+    substep runs and `coeff` is ignored.
     """
     nl = u.shape[0]
     if compute_dtype is None:
         compute_dtype = stencil_ref.compute_dtype(u.dtype)
     if nl % k:
         raise ValueError(f"k={k} must divide the shard depth {nl}")
+    has_field = c2tau2_block is not None
     bx = block_x or choose_kstep_block(
-        u.shape[1], k, u.dtype.itemsize, depth=nl, ghosts=True
+        u.shape[1], k, u.dtype.itemsize, depth=nl, ghosts=True,
+        field=has_field,
     )
     if bx is None:
         raise ValueError(
@@ -1558,7 +1743,19 @@ def fused_kstep_sharded(u_prev, u, prev_ghosts, cur_ghosts, syz, rsyz, sxct,
     kern = functools.partial(
         _kstep_sharded_kernel, k=k, bx=bx, coeff=coeff, inv_h2=inv_h2,
         compute_dtype=compute_dtype, with_errors=with_errors,
+        has_field=has_field,
     )
+    in_specs = [smem]
+    operands = [sxct]
+    if has_field:
+        fld = jnp.asarray(c2tau2_block, dtype=compute_dtype)
+        in_specs += [slab, lo, hi, ghost, ghost]
+        operands += [fld, fld, fld, c2_ghosts[0], c2_ghosts[1]]
+    in_specs += [slab, slab, lo, hi, lo, hi, ghost, ghost, ghost, ghost,
+                 plane, plane]
+    operands += [u_prev, u, u_prev, u_prev, u, u,
+                 prev_ghosts[0], prev_ghosts[1],
+                 cur_ghosts[0], cur_ghosts[1], syz, rsyz]
     state = _out_struct(u)
     out_specs = [slab, slab]
     out_shape = [state, state]
@@ -1569,24 +1766,21 @@ def fused_kstep_sharded(u_prev, u, prev_ghosts, cur_ghosts, syz, rsyz, sxct,
     out = pl.pallas_call(
         kern,
         grid=(nl // bx,),
-        in_specs=[smem, slab, slab, lo, hi, lo, hi,
-                  ghost, ghost, ghost, ghost, plane, plane],
+        in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.CompilerParams(
             vmem_limit_bytes=_KSTEP_VMEM_LIMIT
         ),
         interpret=interpret,
-    )(sxct, u_prev, u, u_prev, u_prev, u, u,
-      prev_ghosts[0], prev_ghosts[1], cur_ghosts[0], cur_ghosts[1],
-      syz, rsyz)
+    )(*operands)
     if with_errors:
         return out
     return out[0], out[1], None, None
 
 
 def _kstep_padded_kernel(*refs, k, bx, bk, coeff, inv_h2, compute_dtype,
-                         with_errors):
+                         with_errors, has_field=False):
     """k leapfrog substeps of an x-sharded block with UNEVEN real depth.
 
     Operands are pre-assembled extended arrays (see
@@ -1606,12 +1800,24 @@ def _kstep_padded_kernel(*refs, k, bx, bk, coeff, inv_h2, compute_dtype,
     zero-pad carry invariant.  Per-plane op order is identical to
     `_kstep_kernel`, so real planes stay bitwise equal to the 1-step
     pallas path (tests/test_sharded_kfused.py uneven cases).
+
+    `has_field` adds bk+2 c^2tau^2 parts assembled IDENTICALLY to the
+    state ext (lo ghosts | D planes | hi spliced at the real boundary,
+    zero junk - a zero coefficient keeps the junk zone finite), read as
+    the static per-substep onion slice.
     """
     it = iter(refs)
     nreal_ref = next(it)                       # SMEM (1,) int32
     sxct_ref = next(it)                        # SMEM (k, D)
     prev_parts = [next(it) for _ in range(bk + 2)]
     cur_parts = [next(it) for _ in range(bk + 2)]
+    f = compute_dtype
+    if has_field:
+        c2_onion = jnp.concatenate(
+            [next(it)[:].astype(f) for _ in range(bk + 2)], 0
+        )
+    else:
+        c2_onion = None
     syz_ref, rsyz_ref = next(it), next(it)
     out = list(it)
     out_prev_ref, out_ref = out[0], out[1]
@@ -1619,7 +1825,6 @@ def _kstep_padded_kernel(*refs, k, bx, bk, coeff, inv_h2, compute_dtype,
         dmax_ref, rmax_ref = out[2], out[3]
 
     i = pl.program_id(0)
-    f = compute_dtype
     n_real = nreal_ref[0]
     ix, iy, iz = (jnp.asarray(v, f) for v in inv_h2)
     prev = jnp.concatenate([p[:].astype(f) for p in prev_parts], 0)
@@ -1641,7 +1846,8 @@ def _kstep_padded_kernel(*refs, k, bx, bk, coeff, inv_h2, compute_dtype,
         lap = lap + (
             pltpu.roll(c, 1, 2) + pltpu.roll(c, nz - 1, 2) - 2.0 * c
         ) * iz
-        new = 2.0 * c + jnp.asarray(coeff, f) * lap - prev[1:-1]
+        new = 2.0 * c + _substep_coeff(c2_onion, coeff, s, f) * lap \
+            - prev[1:-1]
         new = jnp.where(mask, new, jnp.asarray(0.0, f))
         if out_ref.dtype != f:
             new = new.astype(out_ref.dtype).astype(f)
@@ -1674,8 +1880,9 @@ def _kstep_padded_kernel(*refs, k, bx, bk, coeff, inv_h2, compute_dtype,
 
 
 def fused_kstep_padded(ext_prev, ext_cur, n_real, syz, rsyz, sxct, *,
-                       k, coeff, inv_h2, block_x, interpret=False,
-                       with_errors=True, compute_dtype=None):
+                       k, coeff, inv_h2, ext_c2=None, block_x,
+                       interpret=False, with_errors=True,
+                       compute_dtype=None):
     """k fused leapfrog steps of an uneven (pad-and-mask) x-sharded block.
 
     Must run inside `shard_map` on an (MX, 1, 1) mesh (MX = 1 works too:
@@ -1695,10 +1902,16 @@ def fused_kstep_padded(ext_prev, ext_cur, n_real, syz, rsyz, sxct, *,
     point-to-point path (`fused_kstep_sharded`) remains the flagship
     fast path.  k=1 degenerates to a 1-step padded update (used for the
     bootstrap and the remainder tail).
+
+    `ext_c2` is the c^2tau^2 field assembled exactly like `ext_prev`
+    (same lo-ghost/hi-splice layout; the field is time-invariant, so the
+    solver builds it once per solve); with it the variable-c substep runs
+    and `coeff` is ignored.
     """
     dtot, ny, nz = ext_cur.shape
     bx = block_x
     d = dtot - 2 * k
+    has_field = ext_c2 is not None
     if compute_dtype is None:
         compute_dtype = stencil_ref.compute_dtype(ext_cur.dtype)
     if d % bx or bx % k:
@@ -1720,7 +1933,7 @@ def fused_kstep_padded(ext_prev, ext_cur, n_real, syz, rsyz, sxct, *,
     kern = functools.partial(
         _kstep_padded_kernel, k=k, bx=bx, bk=bk, coeff=coeff,
         inv_h2=inv_h2, compute_dtype=compute_dtype,
-        with_errors=with_errors,
+        with_errors=with_errors, has_field=has_field,
     )
     state = _out_struct(ext_cur, shape=(d, ny, nz))
     out_specs = [out_slab, out_slab]
@@ -1729,18 +1942,24 @@ def fused_kstep_padded(ext_prev, ext_cur, n_real, syz, rsyz, sxct, *,
         err = _out_struct(ext_cur, shape=(k, d), dtype=jnp.float32)
         out_specs += [smem, smem]
         out_shape += [err, err]
-    in_specs = [smem, smem] + parts + parts + [plane, plane]
+    in_specs = [smem, smem] + parts + parts
     operands = (
         [jnp.asarray(n_real, jnp.int32).reshape(1), sxct]
-        + [ext_prev] * (bk + 2) + [ext_cur] * (bk + 2) + [syz, rsyz]
+        + [ext_prev] * (bk + 2) + [ext_cur] * (bk + 2)
     )
+    if has_field:
+        fld = jnp.asarray(ext_c2, dtype=compute_dtype)
+        in_specs += parts
+        operands += [fld] * (bk + 2)
+    in_specs += [plane, plane]
+    operands += [syz, rsyz]
     out = pl.pallas_call(
         kern,
         grid=(d // bx,),
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.CompilerParams(
             vmem_limit_bytes=_KSTEP_VMEM_LIMIT
         ),
         interpret=interpret,
@@ -1750,11 +1969,8 @@ def fused_kstep_padded(ext_prev, ext_cur, n_real, syz, rsyz, sxct, *,
     return out[0], out[1], None, None
 
 
-def _kstep_sharded_xy_kernel(off_ref, sxct_ref, uprev_ref, uc_ref, plo_ref,
-                             phi_ref, lo_ref, hi_ref, pglo_ref, pghi_ref,
-                             glo_ref, ghi_ref, syzc_ref, rsyzc_ref,
-                             *out_refs, k, bx, nl_y, n_global, coeff, inv_h2,
-                             compute_dtype, with_errors):
+def _kstep_sharded_xy_kernel(*refs, k, bx, nl_y, n_global, coeff, inv_h2,
+                             compute_dtype, with_errors, has_field=False):
     """`_kstep_sharded_kernel` for blocks ALSO sharded along y.
 
     The solver hands in blocks pre-extended in y by k ghost rows per side
@@ -1769,11 +1985,15 @@ def _kstep_sharded_xy_kernel(off_ref, sxct_ref, uprev_ref, uc_ref, plo_ref,
        be re-zeroed wherever it appears, including inside a ghost strip,
        or its evolved copy would leak nonzero values into real rows;
      * outputs and error maxes slice the central y rows.
+
+    `has_field` adds the c^2tau^2 onion: pre-extended in y like the state
+    (its ghost ROWS hold the real neighbour's coefficients, which the
+    onion-valid ghost-row updates genuinely consume), x ghosts from the
+    extended field.
     """
-    if with_errors:
-        out_prev_ref, out_ref, dmax_ref, rmax_ref = out_refs
-    else:
-        out_prev_ref, out_ref = out_refs
+    it = iter(refs)
+    off_ref = next(it)
+    sxct_ref = next(it)
     i = pl.program_id(0)
     last = pl.num_programs(0) - 1
     f = compute_dtype
@@ -1784,6 +2004,19 @@ def _kstep_sharded_xy_kernel(off_ref, sxct_ref, uprev_ref, uc_ref, plo_ref,
         return jnp.where(
             at_edge, ghost_ref[:].astype(f), wrap_ref[:].astype(f)
         )
+
+    c2_onion = _sharded_field_onion(it, pick, f, has_field)
+    uprev_ref, uc_ref = next(it), next(it)
+    plo_ref, phi_ref = next(it), next(it)
+    lo_ref, hi_ref = next(it), next(it)
+    pglo_ref, pghi_ref = next(it), next(it)
+    glo_ref, ghi_ref = next(it), next(it)
+    syzc_ref, rsyzc_ref = next(it), next(it)
+    out_refs = list(it)
+    if with_errors:
+        out_prev_ref, out_ref, dmax_ref, rmax_ref = out_refs
+    else:
+        out_prev_ref, out_ref = out_refs
 
     prev = jnp.concatenate([
         pick(True, pglo_ref, plo_ref),
@@ -1811,7 +2044,8 @@ def _kstep_sharded_xy_kernel(off_ref, sxct_ref, uprev_ref, uc_ref, plo_ref,
         lap = lap + (
             pltpu.roll(c, 1, 2) + pltpu.roll(c, nz - 1, 2) - 2.0 * c
         ) * iz
-        new = 2.0 * c + jnp.asarray(coeff, f) * lap - prev[1:-1]
+        new = 2.0 * c + _substep_coeff(c2_onion, coeff, s, f) * lap \
+            - prev[1:-1]
         new = jnp.where(mask, new, jnp.asarray(0.0, f))
         if out_ref.dtype != f:
             new = new.astype(out_ref.dtype).astype(f)
@@ -1831,7 +2065,8 @@ def _kstep_sharded_xy_kernel(off_ref, sxct_ref, uprev_ref, uc_ref, plo_ref,
 
 def fused_kstep_sharded_xy(u_prev_ext, u_ext, prev_ghosts, cur_ghosts,
                            syz_c, rsyz_c, sxct, y0, n_global, *,
-                           k, nl_y, coeff, inv_h2, block_x=None,
+                           k, nl_y, coeff, inv_h2, c2tau2_ext=None,
+                           c2_ghosts=None, block_x=None,
                            interpret=False, with_errors=True,
                            compute_dtype=None):
     """k fused leapfrog steps of an (x, y)-sharded block.
@@ -1846,6 +2081,10 @@ def fused_kstep_sharded_xy(u_prev_ext, u_ext, prev_ghosts, cur_ghosts,
     global y offset as an int32 scalar array.  Returns central
     (nl_x, nl_y, nz) layers + (k, nl_x) error rows (max over this shard's
     y range; callers pmax over the y mesh axis).
+
+    With `c2tau2_ext` (the field block y-extended exactly like the state)
+    and `c2_ghosts` (its (lo, hi) x-ghost pair, exchanged once per solve)
+    the variable-c substep runs and `coeff` is ignored.
     """
     nl_x, w, nz = u_ext.shape
     if compute_dtype is None:
@@ -1856,9 +2095,10 @@ def fused_kstep_sharded_xy(u_prev_ext, u_ext, prev_ghosts, cur_ghosts,
         )
     if nl_x % k:
         raise ValueError(f"k={k} must divide the shard depth {nl_x}")
+    has_field = c2tau2_ext is not None
     bx = block_x or choose_kstep_block(
         nz, k, u_ext.dtype.itemsize, depth=nl_x, ghosts=True,
-        plane_elems=w * nz,
+        plane_elems=w * nz, field=has_field,
     )
     if bx is None:
         raise ValueError(f"k={k} does not fit VMEM for {u_ext.shape}")
@@ -1887,7 +2127,19 @@ def fused_kstep_sharded_xy(u_prev_ext, u_ext, prev_ghosts, cur_ghosts,
         _kstep_sharded_xy_kernel, k=k, bx=bx, nl_y=nl_y,
         n_global=n_global, coeff=coeff, inv_h2=inv_h2,
         compute_dtype=compute_dtype, with_errors=with_errors,
+        has_field=has_field,
     )
+    in_specs = [smem, smem]
+    operands = [jnp.asarray(y0, jnp.int32).reshape(1), sxct]
+    if has_field:
+        fld = jnp.asarray(c2tau2_ext, dtype=compute_dtype)
+        in_specs += [slab, lo, hi, ghost, ghost]
+        operands += [fld, fld, fld, c2_ghosts[0], c2_ghosts[1]]
+    in_specs += [slab, slab, lo, hi, lo, hi, ghost, ghost, ghost, ghost,
+                 plane, plane]
+    operands += [u_prev_ext, u_ext, u_prev_ext, u_prev_ext, u_ext, u_ext,
+                 prev_ghosts[0], prev_ghosts[1],
+                 cur_ghosts[0], cur_ghosts[1], syz_c, rsyz_c]
     state = _out_struct(u_ext, shape=(nl_x, nl_y, nz))
     out_specs = [out_slab, out_slab]
     out_shape = [state, state]
@@ -1898,18 +2150,14 @@ def fused_kstep_sharded_xy(u_prev_ext, u_ext, prev_ghosts, cur_ghosts,
     out = pl.pallas_call(
         kern,
         grid=(nl_x // bx,),
-        in_specs=[smem, smem, slab, slab, lo, hi, lo, hi,
-                  ghost, ghost, ghost, ghost, plane, plane],
+        in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.CompilerParams(
             vmem_limit_bytes=_KSTEP_VMEM_LIMIT
         ),
         interpret=interpret,
-    )(jnp.asarray(y0, jnp.int32).reshape(1), sxct,
-      u_prev_ext, u_ext, u_prev_ext, u_prev_ext, u_ext, u_ext,
-      prev_ghosts[0], prev_ghosts[1], cur_ghosts[0], cur_ghosts[1],
-      syz_c, rsyz_c)
+    )(*operands)
     if with_errors:
         return out
     return out[0], out[1], None, None

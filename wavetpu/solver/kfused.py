@@ -24,13 +24,18 @@ kernel per layer with a global sync between); SURVEY.md section 7's perf
 plan called the HBM stream count the budget to beat, and this is the
 mechanism that beats it.
 
-Scope: constant wave speed, standard scheme.  Variable-c would add the
-c^2tau^2 field's own onion (slab + k-plane halos) to the pipeline - at
-N=512 that pushes every k>=2 config over the VMEM budget or down to
-block sizes whose (3 fields + 2k halos)/k traffic per step equals the
-1-step variable-c kernel's, i.e. no win to ship.  The compensated (Kahan)
-scheme triples the state (u, v, carry) with the same conclusion.  Both
-remain available at full speed through their 1-step kernels.
+Variable wave speed composes with the onion: `c2tau2_field` threads the
+tau^2 c^2(x,y,z) slab through every k-block as its own onion (slab +
+k-plane halos, stencil_pallas._kstep_kernel has_field) and through the
+1-step bootstrap/remainder kernels, keeping the bitwise-mixing contract
+with the 1-step variable-c path (tests/test_kfused_varc.py).  The field
+onion's VMEM cost caps the block choice (choose_kstep_block field=True:
+k=2/bx=4 at N=512 under the calibrated budget; k=4/bx=4 models ~5% over
+the physical ceiling and stays reachable via an explicit block_x for
+on-chip attempts - bench.py's kfused_varc row records the outcome).
+There is no analytic oracle for variable c, so a field requires
+compute_errors=False.  The compensated (Kahan) scheme takes the field
+through solver/kfused_comp.py's velocity-form onion.
 """
 
 from __future__ import annotations
@@ -98,16 +103,22 @@ def _block_errors(dmax, rmax, ctk, xmask, inv_absx):
     return abs_e, rel_e
 
 
-def _validate(problem: Problem, k: int):
+def _validate(problem: Problem, k: int, c2tau2_field=None,
+              compute_errors: bool = True):
     if k < 2:
         raise ValueError(f"k must be >= 2 (got {k}); use leapfrog.solve "
                          "with the pallas step for k=1")
     if problem.N % k:
         raise ValueError(f"k={k} must divide N={problem.N}")
+    if c2tau2_field is not None and compute_errors:
+        raise ValueError(
+            "variable-c runs have no analytic oracle; pass "
+            "compute_errors=False with c2tau2_field"
+        )
 
 
 def _make_march(problem, dtype, k, compute_errors, block_x, interpret,
-                nsteps):
+                nsteps, c2tau2_field=None):
     """Shared march: k-fused blocks + a 1-step remainder tail.
 
     Both `make_kfused_solver` and `resume_kfused` MUST use this single
@@ -117,19 +128,35 @@ def _make_march(problem, dtype, k, compute_errors, block_x, interpret,
 
     Returns `march(u_prev, u_cur, start)` -> (u_prev, u_cur, abs, rel)
     covering layers start+1..nsteps (`start` must be a Python int).
+
+    With `c2tau2_field` every k-block runs the variable-c onion and the
+    bootstrap/remainder run the 1-step variable-c pallas kernel - the
+    same ParamStep plumbing as leapfrog.make_solver, so the field is a
+    runtime argument, never an HLO literal.
     """
     f = stencil_ref.compute_dtype(dtype)
     sx, ct, syz, rsyz, xmask, inv_absx = _oracle_parts(problem, f)
     errors = leapfrog._error_fn(problem, dtype)
-    step1 = stencil_pallas.make_step_fn(interpret=interpret)
+    # The field enters the jitted program as a RUNTIME argument (the
+    # `*field_params` splat below: () constant-c, (field,) variable-c) -
+    # closing over it would embed an N^3 HLO literal (leapfrog.ParamStep).
+    step1 = stencil_pallas.make_step_fn(
+        interpret=interpret, c2tau2_field=(
+            None if c2tau2_field is None
+            else jnp.asarray(c2tau2_field, dtype=f)
+        )
+    )
+    step1_fn, params0 = leapfrog._as_param_step(step1)
+    has_field = c2tau2_field is not None
 
-    def kblock(carry, nstart):
+    def kblock(carry, nstart, field_params):
         u_prev, u = carry
         ctk = lax.dynamic_slice(ct, (nstart + 1,), (k,))
         sxct = ctk[:, None] * sx[None, :]
         up, uc, dmax, rmax = stencil_pallas.fused_kstep(
             u_prev, u, syz, rsyz, sxct,
             k=k, coeff=problem.a2tau2, inv_h2=problem.inv_h2,
+            c2tau2_field=field_params[0] if has_field else None,
             block_x=block_x, interpret=interpret,
             with_errors=compute_errors,
         )
@@ -139,19 +166,20 @@ def _make_march(problem, dtype, k, compute_errors, block_x, interpret,
             abs_e = rel_e = jnp.zeros((k,), f)
         return (up, uc), (abs_e, rel_e)
 
-    def march(u_prev, u_cur, start):
+    def march(u_prev, u_cur, start, *field_params):
         nblocks = (nsteps - start) // k
         rem = (nsteps - start) - nblocks * k
         starts = start + k * jnp.arange(nblocks)
         (u_prev, u_cur), (abs_b, rel_b) = lax.scan(
-            kblock, (u_prev, u_cur), starts
+            lambda carry, nstart: kblock(carry, nstart, field_params),
+            (u_prev, u_cur), starts,
         )
         abs_parts = [abs_b.reshape(-1)]
         rel_parts = [rel_b.reshape(-1)]
         if rem:
-            step, params = leapfrog._as_param_step(step1)
+            params = field_params[0] if has_field else params0
             (u_prev, u_cur), (ra, rr) = leapfrog._scan_layers(
-                problem, step, params, errors, compute_errors, dtype,
+                problem, step1_fn, params, errors, compute_errors, dtype,
                 u_prev, u_cur, nsteps - rem, nsteps,
             )
             abs_parts.append(ra)
@@ -159,7 +187,7 @@ def _make_march(problem, dtype, k, compute_errors, block_x, interpret,
         return u_prev, u_cur, jnp.concatenate(abs_parts), jnp.concatenate(
             rel_parts)
 
-    return march, step1, errors
+    return march, step1_fn, errors
 
 
 def make_kfused_solver(
@@ -170,40 +198,58 @@ def make_kfused_solver(
     stop_step: Optional[int] = None,
     block_x: Optional[int] = None,
     interpret: bool = False,
+    c2tau2_field=None,
 ):
-    """Build the jitted k-fused solver; returns a zero-arg runner.
+    """Build the jitted k-fused solver; returns `(runner, run_params)`
+    where `run_params` is the runtime-argument tuple to call the runner
+    with - () for constant speed (a zero-arg runner, as before), or the
+    materialized device field for a variable-c solve (the field must ride
+    as an argument, not a constant; see leapfrog.ParamStep).
 
     Layers 0/1 bootstrap exactly as `leapfrog.make_solver` with the pallas
     1-step kernel; then (nsteps-1)//k fused blocks; a remainder of
     (nsteps-1) % k layers runs the 1-step kernel (same ops, so the tail is
-    seamless).  Requires k >= 2 and N % k == 0.
+    seamless).  Requires k >= 2 and N % k == 0; a field requires
+    compute_errors=False (no analytic oracle).
     """
-    _validate(problem, k)
+    _validate(problem, k, c2tau2_field, compute_errors)
     nsteps = problem.timesteps if stop_step is None else stop_step
     if not 1 <= nsteps <= problem.timesteps:
         raise ValueError(
             f"stop_step must be in [1, {problem.timesteps}], got {nsteps}"
         )
     f = stencil_ref.compute_dtype(dtype)
-    march, step1, errors = _make_march(
-        problem, dtype, k, compute_errors, block_x, interpret, nsteps
+    # Materialize the field ONCE; _make_march's jnp.asarray on this
+    # committed device array is a no-copy, so the step closure and the
+    # runtime argument share one N^3 buffer (no duplicate HBM/upload).
+    field_dev = None
+    if c2tau2_field is not None:
+        field_dev = leapfrog.ParamStep.materialize(
+            jnp.asarray(c2tau2_field, dtype=f)
+        )
+    march, step1_fn, errors = _make_march(
+        problem, dtype, k, compute_errors, block_x, interpret, nsteps,
+        field_dev,
     )
 
-    def run():
+    def run(*field_params):
         u0 = leapfrog.initial_layer0(problem, dtype)
-        u1 = (0.5 * (u0.astype(f) + step1(u0, u0, problem).astype(f))
+        params = field_params[0] if field_params else ()
+        u1 = (0.5 * (u0.astype(f)
+                     + step1_fn(u0, u0, problem, params).astype(f))
               ).astype(dtype)
         a0 = r0 = jnp.zeros((), f)
         if compute_errors:
             a1, r1 = errors(u1, 1)
         else:
             a1 = r1 = jnp.zeros((), f)
-        u_prev, u_cur, abs_t, rel_t = march(u0, u1, 1)
+        u_prev, u_cur, abs_t, rel_t = march(u0, u1, 1, *field_params)
         abs_all = jnp.concatenate([jnp.stack([a0, a1]), abs_t])
         rel_all = jnp.concatenate([jnp.stack([r0, r1]), rel_t])
         return u_prev, u_cur, abs_all, rel_all
 
-    return jax.jit(run)
+    run_params = () if field_dev is None else (field_dev,)
+    return jax.jit(run), run_params
 
 
 def solve_kfused(
@@ -214,15 +260,19 @@ def solve_kfused(
     stop_step: Optional[int] = None,
     block_x: Optional[int] = None,
     interpret: bool = False,
+    c2tau2_field=None,
 ) -> leapfrog.SolveResult:
     """Compile + run the k-fused solve (reference timing phases as
-    `leapfrog.solve`)."""
-    runner = make_kfused_solver(
-        problem, dtype, k, compute_errors, stop_step, block_x, interpret
+    `leapfrog.solve`).  `c2tau2_field` (host (N,N,N) tau^2 c^2 array,
+    `stencil_ref.make_c2tau2_field`) selects the variable-c onion; pair
+    it with compute_errors=False."""
+    runner, run_params = make_kfused_solver(
+        problem, dtype, k, compute_errors, stop_step, block_x, interpret,
+        c2tau2_field,
     )
     (u_prev, u_cur, abs_all, rel_all), init_s, solve_s = (
         leapfrog._timed_compile_run(
-            runner, (), sync=lambda out: np.asarray(out[2])
+            runner, run_params, sync=lambda out: np.asarray(out[2])
         )
     )
     return leapfrog.SolveResult(
@@ -248,27 +298,40 @@ def resume_kfused(
     compute_errors: bool = True,
     block_x: Optional[int] = None,
     interpret: bool = False,
+    c2tau2_field=None,
 ) -> leapfrog.SolveResult:
     """Re-enter the k-fused march at layer `start_step`.
 
     Because every k-fused substep is op-identical to the 1-step pallas
     kernel's step, a checkpoint written by either path resumes bitwise-
     equal under either path (error arrays cover start_step+1..timesteps,
-    earlier entries zero, as `leapfrog.resume`).
+    earlier entries zero, as `leapfrog.resume`).  A variable-c checkpoint
+    resumes under the SAME field, re-passed by the caller (checkpoints
+    store state, not the coefficient field).
     """
-    _validate(problem, k)
+    _validate(problem, k, c2tau2_field, compute_errors)
     nsteps = problem.timesteps
     if not 1 <= start_step <= nsteps:
         raise ValueError(
             f"start_step must be in [1, {nsteps}], got {start_step}"
         )
     f = stencil_ref.compute_dtype(dtype)
+    # One materialization shared by the step closure and the runtime
+    # argument (see make_kfused_solver).
+    field_dev = None
+    if c2tau2_field is not None:
+        field_dev = leapfrog.ParamStep.materialize(
+            jnp.asarray(c2tau2_field, dtype=f)
+        )
     march, _, _ = _make_march(
-        problem, dtype, k, compute_errors, block_x, interpret, nsteps
+        problem, dtype, k, compute_errors, block_x, interpret, nsteps,
+        field_dev,
     )
 
-    def run(u_prev, u_cur):
-        u_prev, u_cur, abs_t, rel_t = march(u_prev, u_cur, start_step)
+    def run(u_prev, u_cur, *field_params):
+        u_prev, u_cur, abs_t, rel_t = march(
+            u_prev, u_cur, start_step, *field_params
+        )
         head = jnp.zeros((start_step + 1,), f)
         return (
             u_prev, u_cur,
@@ -277,6 +340,8 @@ def resume_kfused(
         )
 
     args = (jnp.asarray(u_prev, dtype), jnp.asarray(u_cur, dtype))
+    if field_dev is not None:
+        args = args + (field_dev,)
     (u_p, u_c, abs_all, rel_all), init_s, solve_s = (
         leapfrog._timed_compile_run(
             jax.jit(run), args, sync=lambda out: np.asarray(out[2])

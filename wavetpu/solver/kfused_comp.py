@@ -57,6 +57,7 @@ import numpy as np
 from jax import lax
 
 from wavetpu.core.problem import Problem
+from wavetpu import compat
 from wavetpu.kernels import stencil_pallas, stencil_ref
 from wavetpu.solver import kfused, leapfrog
 
@@ -107,12 +108,18 @@ def _normalize_carry(carry, dtype):
     return jnp.asarray(carry, dtype)
 
 
-def _validate(problem: Problem, dtype, v_dtype, carry, k: int):
+def _validate(problem: Problem, dtype, v_dtype, carry, k: int,
+              c2tau2_field=None, compute_errors: bool = True):
     if k < 2:
         raise ValueError(f"k must be >= 2 (got {k}); use "
                          "leapfrog.solve_compensated for k=1")
     if problem.N % k:
         raise ValueError(f"k={k} must divide N={problem.N}")
+    if c2tau2_field is not None and compute_errors:
+        raise ValueError(
+            "variable-c runs have no analytic oracle; pass "
+            "compute_errors=False with c2tau2_field"
+        )
     if dtype == jnp.bfloat16:
         raise ValueError(
             "compensated/velocity scheme requires an f32/f64 carrier u "
@@ -155,13 +162,15 @@ def _error_fn_guarded(problem: Problem, dtype):
 
 
 def _make_march(problem, dtype, v_dtype, carry_on, k, compute_errors,
-                block_x, interpret, nsteps):
+                block_x, interpret, nsteps, has_field=False):
     """Shared march: k-fused blocks + a k=1 tail through the SAME kernel.
 
-    Returns `march(u, v, carry, start)` -> (u, v, carry, abs, rel)
-    covering layers start+1..nsteps (`start` a Python int).  Shared by
-    solve and resume so a resumed run's op sequence equals the
-    uninterrupted run's.
+    Returns `march(u, v, carry, start, *field_params)` ->
+    (u, v, carry, abs, rel) covering layers start+1..nsteps (`start` a
+    Python int).  Shared by solve and resume so a resumed run's op
+    sequence equals the uninterrupted run's.  With `has_field` the
+    c^2tau^2 field rides `field_params[0]` as a runtime argument
+    (leapfrog.ParamStep reasoning) into every onion call.
     """
     f = stencil_ref.compute_dtype(dtype)
     sx, ct, syz, rsyz, xmask, inv_absx = kfused._oracle_parts(problem, f)
@@ -181,12 +190,13 @@ def _make_march(problem, dtype, v_dtype, carry_on, k, compute_errors,
     inv_absx = jnp.where(jnp.abs(sx) > _rel_guard_tol(f), inv_absx,
                          jnp.asarray(0.0, f))
 
-    def kblock(u, v, carry, nstart, kk, bxo):
+    def kblock(u, v, carry, nstart, kk, bxo, field=None):
         ctk = lax.dynamic_slice(ct, (nstart + 1,), (kk,))
         sxct = ctk[:, None] * sx[None, :]
         u2, v2, c2, dmax, rmax = stencil_pallas.fused_kstep_comp(
             u, v, carry, syz, rsyz, sxct,
             k=kk, coeff=problem.a2tau2, inv_h2=problem.inv_h2,
+            c2tau2_field=field,
             block_x=bxo, interpret=interpret, with_errors=compute_errors,
         )
         if compute_errors:
@@ -197,14 +207,15 @@ def _make_march(problem, dtype, v_dtype, carry_on, k, compute_errors,
             abs_e = rel_e = jnp.zeros((kk,), f)
         return u2, v2, c2, abs_e, rel_e
 
-    def march(u, v, carry, start):
+    def march(u, v, carry, start, *field_params):
+        field = field_params[0] if has_field else None
         nblocks = (nsteps - start) // k
         rem = (nsteps - start) - nblocks * k
 
         def body(state, nstart):
             u, v, carry = state
             u2, v2, c2, abs_e, rel_e = kblock(
-                u, v, carry, nstart, k, block_x
+                u, v, carry, nstart, k, block_x, field
             )
             return (u2, v2, c2), (abs_e, rel_e)
 
@@ -216,7 +227,7 @@ def _make_march(problem, dtype, v_dtype, carry_on, k, compute_errors,
         rel_parts = [rel_b.reshape(-1)]
         for t in range(rem):
             u, v, carry, abs_1, rel_1 = kblock(
-                u, v, carry, nsteps - rem + t, 1, None
+                u, v, carry, nsteps - rem + t, 1, None, field
             )
             abs_parts.append(abs_1)
             rel_parts.append(rel_1)
@@ -226,19 +237,35 @@ def _make_march(problem, dtype, v_dtype, carry_on, k, compute_errors,
     return march
 
 
-def _bootstrap(problem, dtype, v_dtype, carry_on, carry_dtype, interpret):
+def _bootstrap(problem, dtype, v_dtype, carry_on, carry_dtype, interpret,
+               field=None):
     """Layers 0/1: analytic init + the compensated kernel's half-step.
 
     u1 = u0 + (C/2)lap(u0) with v = carry = 0 primes (u1, v1, carry1)
     exactly as `leapfrog.make_compensated_solver` (reference bootstrap:
-    openmp_sol.cpp:123-145)."""
+    openmp_sol.cpp:123-145).  With a `field` the half-step coefficient is
+    tau^2 c^2(x)/2 and the k=1 onion kernel runs it (op-for-op the same
+    Kahan sequence, with the field as the Laplacian coefficient)."""
     u0 = leapfrog.initial_layer0(problem, dtype)
-    zero = jnp.zeros_like(u0)
-    u1, v1, c1 = stencil_pallas.compensated_step(
-        u0, zero, zero, problem, 0.5 * problem.a2tau2, interpret=interpret
+    if field is None:
+        zero = jnp.zeros_like(u0)
+        u1, v1, c1 = stencil_pallas.compensated_step(
+            u0, zero, zero, problem, 0.5 * problem.a2tau2,
+            interpret=interpret
+        )
+        v1 = v1.astype(v_dtype)
+        c1 = c1.astype(carry_dtype) if carry_on else None
+        return u1, v1, c1
+    f = stencil_ref.compute_dtype(dtype)
+    n = problem.N
+    zero_plane = jnp.zeros((n, n), f)
+    u1, v1, c1, _, _ = stencil_pallas.fused_kstep_comp(
+        u0, jnp.zeros(u0.shape, v_dtype),
+        jnp.zeros(u0.shape, carry_dtype) if carry_on else None,
+        zero_plane, zero_plane, jnp.zeros((1, n), f),
+        k=1, coeff=None, inv_h2=problem.inv_h2,
+        c2tau2_field=0.5 * field, interpret=interpret, with_errors=False,
     )
-    v1 = v1.astype(v_dtype)
-    c1 = c1.astype(carry_dtype) if carry_on else None
     return u1, v1, c1
 
 
@@ -253,9 +280,13 @@ def make_kfused_comp_solver(
     v_dtype=None,
     carry: bool = True,
     carry_dtype=None,
+    c2tau2_field=None,
 ):
-    """Build the jitted compensated k-fused solver; returns a zero-arg
-    runner yielding (u, v, carry|None, abs_errors, rel_errors).
+    """Build the jitted compensated k-fused solver; returns
+    `(runner, run_params)` yielding (u, v, carry|None, abs_errors,
+    rel_errors).  `run_params` is () for constant speed (zero-arg runner,
+    as before) or the materialized device field for variable c (a runtime
+    argument, never an HLO literal - leapfrog.ParamStep).
 
     `carry_dtype` (default: `_default_carry_dtype`, i.e. bf16 for f32
     runs) narrows only the carry's HBM stream - see that helper for the
@@ -268,34 +299,42 @@ def make_kfused_comp_solver(
     )
     if carry:
         _validate_carry_dtype(dtype, carry_dtype)
-    _validate(problem, dtype, v_dtype, carry, k)
+    _validate(problem, dtype, v_dtype, carry, k, c2tau2_field,
+              compute_errors)
     nsteps = problem.timesteps if stop_step is None else stop_step
     if not 1 <= nsteps <= problem.timesteps:
         raise ValueError(
             f"stop_step must be in [1, {problem.timesteps}], got {nsteps}"
         )
     f = stencil_ref.compute_dtype(dtype)
+    has_field = c2tau2_field is not None
     errors = _error_fn_guarded(problem, dtype)
     march = _make_march(
         problem, dtype, v_dtype, carry, k, compute_errors, block_x,
-        interpret, nsteps,
+        interpret, nsteps, has_field,
     )
 
-    def run():
+    def run(*field_params):
         u1, v1, c1 = _bootstrap(
-            problem, dtype, v_dtype, carry, carry_dtype, interpret
+            problem, dtype, v_dtype, carry, carry_dtype, interpret,
+            field_params[0] if has_field else None,
         )
         a0 = r0 = jnp.zeros((), f)
         if compute_errors:
             a1, r1 = errors(u1, 1)
         else:
             a1 = r1 = jnp.zeros((), f)
-        u, v, c, abs_t, rel_t = march(u1, v1, c1, 1)
+        u, v, c, abs_t, rel_t = march(u1, v1, c1, 1, *field_params)
         abs_all = jnp.concatenate([jnp.stack([a0, a1]), abs_t])
         rel_all = jnp.concatenate([jnp.stack([r0, r1]), rel_t])
         return u, v, c, abs_all, rel_all
 
-    return jax.jit(run)
+    run_params = ()
+    if has_field:
+        run_params = (leapfrog.ParamStep.materialize(
+            jnp.asarray(c2tau2_field, dtype=f)
+        ),)
+    return jax.jit(run), run_params
 
 
 def _as_result(problem, out, init_s, solve_s, steps_computed, final_step):
@@ -327,15 +366,18 @@ def solve_kfused_comp(
     v_dtype=None,
     carry: bool = True,
     carry_dtype=None,
+    c2tau2_field=None,
 ) -> leapfrog.SolveResult:
     """Compile + run the compensated k-fused solve (reference timing
-    phases as `leapfrog.solve`)."""
-    runner = make_kfused_comp_solver(
+    phases as `leapfrog.solve`).  `c2tau2_field` selects the variable-c
+    velocity-form onion (composes with the carry and the bf16-increment
+    mode); pair it with compute_errors=False."""
+    runner, run_params = make_kfused_comp_solver(
         problem, dtype, k, compute_errors, stop_step, block_x, interpret,
-        v_dtype, carry, carry_dtype,
+        v_dtype, carry, carry_dtype, c2tau2_field,
     )
     out, init_s, solve_s = leapfrog._timed_compile_run(
-        runner, (), sync=lambda o: np.asarray(o[3])
+        runner, run_params, sync=lambda o: np.asarray(o[3])
     )
     return _as_result(
         problem, out, init_s, solve_s, stop_step,
@@ -344,8 +386,10 @@ def solve_kfused_comp(
 
 
 def _validate_sharded(problem: Problem, dtype, v_dtype, carry, k, n_x,
-                      n_y: int = 1):
-    _validate(problem, dtype, v_dtype, carry, k)
+                      n_y: int = 1, c2tau2_field=None,
+                      compute_errors: bool = True):
+    _validate(problem, dtype, v_dtype, carry, k, c2tau2_field,
+              compute_errors)
     if n_x < 1 or n_y < 1:
         raise ValueError(
             f"mesh axes must be >= 1 (got MX={n_x}, MY={n_y})"
@@ -372,7 +416,7 @@ def _validate_sharded(problem: Problem, dtype, v_dtype, carry, k, n_x,
 
 def _make_sharded_runner(problem, mesh, grid, dtype, v_dtype, carry_on, k,
                          compute_errors, nsteps, start_step, block_x,
-                         interpret, carry_dtype=None):
+                         interpret, carry_dtype=None, has_field=False):
     """Sharded velocity-form runner over (MX, MY, 1): the distributed
     flagship.
 
@@ -386,6 +430,11 @@ def _make_sharded_runner(problem, mesh, grid, dtype, v_dtype, carry_on, k,
     bootstrap and the remainder tail run the same kernel at k=1 (the
     bootstrap with coeff C/2 on zero v/carry IS the compensated
     half-step).
+
+    With `has_field` the c^2tau^2 field rides as an extra P("x","y")
+    runtime argument; it is time-invariant, so its y extension and
+    x-ghost exchange happen ONCE per solve per needed ghost depth
+    (k-blocks; k=1 for bootstrap/remainder), outside the layer scan.
     """
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
@@ -416,11 +465,12 @@ def _make_sharded_runner(problem, mesh, grid, dtype, v_dtype, carry_on, k,
     if n_y == 1:
         bx = block_x or stencil_pallas.choose_kstep_comp_block(
             problem.N, k, *itemsizes, depth=nl, ghosts=True,
+            field=has_field,
         )
     else:
         bx = block_x or stencil_pallas.choose_kstep_comp_block(
             problem.N, k, *itemsizes, depth=nl, ghosts=True,
-            plane_elems=(nl_y + 2 * k) * problem.N,
+            plane_elems=(nl_y + 2 * k) * problem.N, field=has_field,
         )
     if bx is None:
         raise ValueError(
@@ -443,11 +493,25 @@ def _make_sharded_runner(problem, mesh, grid, dtype, v_dtype, carry_on, k,
         hi = lax.ppermute(a[:, :kk], "y", perm_bwd_y)
         return jnp.concatenate([lo, a, hi], axis=1)
 
-    def kcall(syz_c, rsyz_c, u, v, c, sxct_k, kk, coeff, with_err):
+    def field_pack(fld, kk):
+        """(block_or_ext, x-ghost pair) for the time-invariant field at
+        ghost depth kk - built once per solve per needed depth."""
+        if fld is None:
+            return None
+        if n_y == 1:
+            return fld, ghosts(fld, kk)
+        fe = extend_y(fld, kk)
+        return fe, ghosts(fe, kk)
+
+    def kcall(syz_c, rsyz_c, u, v, c, sxct_k, kk, coeff, with_err,
+              fp=None):
+        c2b = fp[0] if fp is not None else None
+        c2g = fp[1] if fp is not None else None
         if n_y == 1:
             return stencil_pallas.fused_kstep_comp_sharded(
                 u, v, c, ghosts(u, kk), ghosts(v, kk), syz_c, rsyz_c,
                 sxct_k, k=kk, coeff=coeff, inv_h2=problem.inv_h2,
+                c2tau2_block=c2b, c2_ghosts=c2g,
                 block_x=bx, interpret=interpret, with_errors=with_err,
             )
         ue, ve = extend_y(u, kk), extend_y(v, kk)
@@ -455,7 +519,8 @@ def _make_sharded_runner(problem, mesh, grid, dtype, v_dtype, carry_on, k,
         u2, v2, c2, dm, rm = stencil_pallas.fused_kstep_comp_sharded_xy(
             ue, ve, c, ghosts(ue, kk), ghosts(ve, kk), syz_c, rsyz_c,
             sxct_k, y0, problem.N, k=kk, nl_y=nl_y, coeff=coeff,
-            inv_h2=problem.inv_h2, block_x=bx, interpret=interpret,
+            inv_h2=problem.inv_h2, c2tau2_ext=c2b, c2_ghosts=c2g,
+            block_x=bx, interpret=interpret,
             with_errors=with_err,
         )
         if with_err:
@@ -470,15 +535,17 @@ def _make_sharded_runner(problem, mesh, grid, dtype, v_dtype, carry_on, k,
             r = lax.pmax(r, "y")
         return d, r
 
-    def local_march(syz_c, rsyz_c, u, v, c, sxct_loc, first):
+    def local_march(syz_c, rsyz_c, u, v, c, sxct_loc, first, fld=None):
         rows_d, rows_r = [], []
+        fp_k = field_pack(fld, k)
+        fp_1 = field_pack(fld, 1) if rem else None
 
         def body(state, nstart):
             u, v, c = state
             sxct_k = lax.dynamic_slice(sxct_loc, (nstart + 1, 0), (k, nl))
             u2, v2, c2, dm, rm = kcall(
                 syz_c, rsyz_c, u, v, c, sxct_k, k, problem.a2tau2,
-                compute_errors,
+                compute_errors, fp_k,
             )
             if not compute_errors:
                 dm = rm = jnp.zeros((k, nl), f)
@@ -493,7 +560,7 @@ def _make_sharded_runner(problem, mesh, grid, dtype, v_dtype, carry_on, k,
             sxct_1 = lax.dynamic_slice(sxct_loc, (layer, 0), (1, nl))
             u, v, c, dm, rm = kcall(
                 syz_c, rsyz_c, u, v, c, sxct_1, 1, problem.a2tau2,
-                compute_errors,
+                compute_errors, fp_1,
             )
             if not compute_errors:
                 dm = rm = jnp.zeros((1, nl), f)
@@ -513,9 +580,12 @@ def _make_sharded_runner(problem, mesh, grid, dtype, v_dtype, carry_on, k,
     rows_spec = P(None, "x")
     plane_spec = P("y", None)
 
+    field_specs = (state_spec,) if has_field else ()
+
     if start_step is None:
 
-        def local(u0, sxct_loc, syz_c, rsyz_c):
+        def local(u0, sxct_loc, syz_c, rsyz_c, *fargs):
+            fld = fargs[0] if has_field else None
             zero_v = jnp.zeros(u0.shape, v_dtype)
             zero_c = (
                 jnp.zeros(u0.shape, carry_dtype) if carry_on else None
@@ -523,13 +593,14 @@ def _make_sharded_runner(problem, mesh, grid, dtype, v_dtype, carry_on, k,
             u1, v1, c1, _, _ = kcall(
                 syz_c, rsyz_c, u0, zero_v, zero_c,
                 jnp.zeros((1, nl), f), 1, 0.5 * problem.a2tau2, False,
+                field_pack(0.5 * fld, 1) if has_field else None,
             )
             if compute_errors:
                 d1, r1 = layer_rows(syz_c, rsyz_c, u1, sxct_loc[1])
             else:
                 d1 = r1 = jnp.zeros((1, nl), f)
             u, v, c, rows_d, rows_r = local_march(
-                syz_c, rsyz_c, u1, v1, c1, sxct_loc, 1
+                syz_c, rsyz_c, u1, v1, c1, sxct_loc, 1, fld
             )
             zero = jnp.zeros((1, nl), f)
             return (
@@ -538,29 +609,33 @@ def _make_sharded_runner(problem, mesh, grid, dtype, v_dtype, carry_on, k,
                 jnp.concatenate([zero, r1, rows_r]),
             )
 
-        local_fn = jax.shard_map(
+        local_fn = compat.shard_map(
             local, mesh=mesh,
-            in_specs=(state_spec, rows_spec, plane_spec, plane_spec),
+            in_specs=(state_spec, rows_spec, plane_spec, plane_spec)
+            + field_specs,
             out_specs=(state_spec, state_spec,
                        state_spec if carry_on else None,
                        rows_spec, rows_spec),
             check_vma=False,
         )
 
-        def run():
+        def run(*fargs):
             u0 = lax.with_sharding_constraint(
                 leapfrog.initial_layer0(problem, dtype),
                 NamedSharding(mesh, state_spec),
             )
-            u, v, c, dmax, rmax = local_fn(u0, sxct_all, syz, rsyz)
+            u, v, c, dmax, rmax = local_fn(
+                u0, sxct_all, syz, rsyz, *fargs
+            )
             abs_e, rel_e = assemble(dmax, rmax)
             return u, v, c, abs_e, rel_e
 
         return jax.jit(run)
 
-    def local_resume(u, v, c, sxct_loc, syz_c, rsyz_c):
+    def local_resume(u, v, c, sxct_loc, syz_c, rsyz_c, *fargs):
         u, v, c, rows_d, rows_r = local_march(
-            syz_c, rsyz_c, u, v, c, sxct_loc, start_step
+            syz_c, rsyz_c, u, v, c, sxct_loc, start_step,
+            fargs[0] if has_field else None,
         )
         head = jnp.zeros((start_step + 1, nl), f)
         return (
@@ -569,19 +644,20 @@ def _make_sharded_runner(problem, mesh, grid, dtype, v_dtype, carry_on, k,
             jnp.concatenate([head, rows_r]),
         )
 
-    local_fn = jax.shard_map(
+    local_fn = compat.shard_map(
         local_resume, mesh=mesh,
         in_specs=(state_spec, state_spec,
                   state_spec if carry_on else None,
-                  rows_spec, plane_spec, plane_spec),
+                  rows_spec, plane_spec, plane_spec) + field_specs,
         out_specs=(state_spec, state_spec,
                    state_spec if carry_on else None,
                    rows_spec, rows_spec),
         check_vma=False,
     )
 
-    def run(u, v, c):
-        u, v, c, dmax, rmax = local_fn(u, v, c, sxct_all, syz, rsyz)
+    def run(u, v, c, *fargs):
+        u, v, c, dmax, rmax = local_fn(u, v, c, sxct_all, syz, rsyz,
+                                       *fargs)
         abs_e, rel_e = assemble(dmax, rmax)
         return u, v, c, abs_e, rel_e
 
@@ -602,13 +678,17 @@ def solve_kfused_comp_sharded(
     carry: bool = True,
     mesh_shape=None,
     carry_dtype=None,
+    c2tau2_field=None,
 ) -> leapfrog.SolveResult:
     """Distributed velocity-form compensated k-fused solve over an
     (MX, MY, 1) mesh - the flagship scheme at the reference's
     distributed scale (mpi_new.cpp's role), with the compensated
     accuracy contract.  `n_shards` is the x-only shorthand.  Requires
     MX | N, k | N/MX, MY | N, k <= N/MY.  `carry_dtype` as
-    `solve_kfused_comp`."""
+    `solve_kfused_comp`; `c2tau2_field` threads the variable-c field
+    through the sharded onion (compute_errors=False required) - the c^2
+    slab is sharded on the same mesh with its ghost exchange hoisted out
+    of the layer scan (the field is time-invariant)."""
     from wavetpu.core.grid import build_mesh
     from wavetpu.solver.sharded_kfused import _resolve_grid
 
@@ -620,20 +700,32 @@ def solve_kfused_comp_sharded(
     v_dtype = dtype if v_dtype is None else jnp.dtype(v_dtype)
     if carry and carry_dtype is not None:
         _validate_carry_dtype(dtype, carry_dtype)
-    _validate_sharded(problem, dtype, v_dtype, carry, k, n_x, n_y)
+    _validate_sharded(problem, dtype, v_dtype, carry, k, n_x, n_y,
+                      c2tau2_field, compute_errors)
     nsteps = problem.timesteps if stop_step is None else stop_step
     if not 1 <= nsteps <= problem.timesteps:
         raise ValueError(
             f"stop_step must be in [1, {problem.timesteps}], got {nsteps}"
         )
     mesh = build_mesh((n_x, n_y, 1), devices[: n_x * n_y])
+    has_field = c2tau2_field is not None
     runner = _make_sharded_runner(
         problem, mesh, (n_x, n_y), dtype, v_dtype, carry, k,
         compute_errors, nsteps, None, block_x, interpret,
-        carry_dtype=carry_dtype,
+        carry_dtype=carry_dtype, has_field=has_field,
     )
+    run_params = ()
+    if has_field:
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        f = stencil_ref.compute_dtype(dtype)
+        run_params = (jax.device_put(
+            jnp.asarray(c2tau2_field, dtype=f),
+            NamedSharding(mesh, P("x", "y")),
+        ),)
     out, init_s, solve_s = leapfrog._timed_compile_run(
-        runner, (), sync=lambda o: np.asarray(o[3])
+        runner, run_params, sync=lambda o: np.asarray(o[3])
     )
     return _as_result(
         problem, out, init_s, solve_s, stop_step,
@@ -656,10 +748,12 @@ def resume_kfused_comp_sharded(
     devices=None,
     v_dtype=None,
     mesh_shape=None,
+    c2tau2_field=None,
 ) -> leapfrog.SolveResult:
     """Re-enter the sharded velocity-form march at layer `start_step`
     from compensated checkpoint state (carry=None resumes the carry-less
-    increment form)."""
+    increment form).  A variable-c checkpoint resumes under the same
+    re-passed `c2tau2_field`."""
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
@@ -673,7 +767,8 @@ def resume_kfused_comp_sharded(
         interpret = jax.default_backend() != "tpu"
     v_dtype = dtype if v_dtype is None else jnp.dtype(v_dtype)
     carry_on = carry is not None
-    _validate_sharded(problem, dtype, v_dtype, carry_on, k, n_x, n_y)
+    _validate_sharded(problem, dtype, v_dtype, carry_on, k, n_x, n_y,
+                      c2tau2_field, compute_errors)
     nsteps = problem.timesteps
     if not 1 <= start_step <= nsteps:
         raise ValueError(
@@ -684,10 +779,12 @@ def resume_kfused_comp_sharded(
         # No-copy dtype probe + the same preserve-or-cast rule as
         # resume_kfused_comp.
         carry = _normalize_carry(carry, dtype)
+    has_field = c2tau2_field is not None
     runner = _make_sharded_runner(
         problem, mesh, (n_x, n_y), dtype, v_dtype, carry_on, k,
         compute_errors, nsteps, start_step, block_x, interpret,
         carry_dtype=jnp.result_type(carry) if carry_on else None,
+        has_field=has_field,
     )
     sharding = NamedSharding(mesh, P("x", "y"))
     args = (
@@ -695,6 +792,11 @@ def resume_kfused_comp_sharded(
         jax.device_put(jnp.asarray(v, v_dtype), sharding),
         jax.device_put(carry, sharding) if carry_on else None,
     )
+    if has_field:
+        f = stencil_ref.compute_dtype(dtype)
+        args = args + (jax.device_put(
+            jnp.asarray(c2tau2_field, dtype=f), sharding
+        ),)
     out, init_s, solve_s = leapfrog._timed_compile_run(
         runner, args, sync=lambda o: np.asarray(o[3])
     )
@@ -715,6 +817,7 @@ def resume_kfused_comp(
     block_x: Optional[int] = None,
     interpret: bool = False,
     v_dtype=None,
+    c2tau2_field=None,
 ) -> leapfrog.SolveResult:
     """Re-enter the compensated k-fused march at layer `start_step`.
 
@@ -723,24 +826,30 @@ def resume_kfused_comp(
     carry-less increment form.  The march is the same op sequence as an
     uninterrupted run's from that layer, so a same-path resume is
     self-consistent; a cross-path resume (1-step compensated <-> k-fused)
-    agrees to scheme tolerance, not bitwise.
+    agrees to scheme tolerance, not bitwise.  A variable-c checkpoint
+    resumes under the same re-passed `c2tau2_field` (checkpoints store
+    state, not the field).
     """
     v_dtype = dtype if v_dtype is None else jnp.dtype(v_dtype)
     carry_on = carry is not None
-    _validate(problem, dtype, v_dtype, carry_on, k)
+    _validate(problem, dtype, v_dtype, carry_on, k, c2tau2_field,
+              compute_errors)
     nsteps = problem.timesteps
     if not 1 <= start_step <= nsteps:
         raise ValueError(
             f"start_step must be in [1, {nsteps}], got {start_step}"
         )
     f = stencil_ref.compute_dtype(dtype)
+    has_field = c2tau2_field is not None
     march = _make_march(
         problem, dtype, v_dtype, carry_on, k, compute_errors, block_x,
-        interpret, nsteps,
+        interpret, nsteps, has_field,
     )
 
-    def run(u_cur, v, carry):
-        u, vv, cc, abs_t, rel_t = march(u_cur, v, carry, start_step)
+    def run(u_cur, v, carry, *field_params):
+        u, vv, cc, abs_t, rel_t = march(
+            u_cur, v, carry, start_step, *field_params
+        )
         head = jnp.zeros((start_step + 1,), f)
         return (
             u, vv, cc,
@@ -756,6 +865,10 @@ def resume_kfused_comp(
         # (e.g. f64 carry into an f32 run) cast to the state dtype.
         _normalize_carry(carry, dtype) if carry_on else None,
     )
+    if has_field:
+        args = args + (leapfrog.ParamStep.materialize(
+            jnp.asarray(c2tau2_field, dtype=f)
+        ),)
     out, init_s, solve_s = leapfrog._timed_compile_run(
         jax.jit(run), args, sync=lambda o: np.asarray(o[3])
     )

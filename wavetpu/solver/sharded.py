@@ -48,6 +48,7 @@ from jax.sharding import PartitionSpec as P
 from wavetpu.comm import halo
 from wavetpu.core.grid import AXIS_NAMES, Topology, build_mesh, choose_mesh_shape
 from wavetpu.core.problem import Problem
+from wavetpu import compat
 from wavetpu.kernels import stencil_pallas, stencil_ref
 from wavetpu.solver.leapfrog import SolveResult
 from wavetpu.verify import oracle
@@ -534,7 +535,7 @@ def make_sharded_solver(
     # check_vma=False: the Pallas interpret path (CPU tests/dryruns) does
     # not yet propagate varying-mesh-axes through in-kernel concatenates;
     # parity with the roll kernel is pinned by tests instead.
-    sharded_fn = jax.shard_map(
+    sharded_fn = compat.shard_map(
         local_solve,
         mesh=mesh,
         in_specs=tuple(in_specs),
@@ -614,7 +615,7 @@ def make_sharded_resumer(
     out_specs = [state_spec, state_spec, P(), P()]
     if compensated:
         out_specs += [state_spec, state_spec]
-    sharded_fn = jax.shard_map(
+    sharded_fn = compat.shard_map(
         local_resume,
         mesh=mesh,
         in_specs=tuple(in_specs),

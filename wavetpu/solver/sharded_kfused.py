@@ -48,6 +48,7 @@ from jax.sharding import PartitionSpec as P
 
 from wavetpu.core.grid import build_mesh
 from wavetpu.core.problem import Problem
+from wavetpu import compat
 from wavetpu.kernels import stencil_pallas, stencil_ref
 from wavetpu.solver import kfused, leapfrog
 from wavetpu.solver.leapfrog import SolveResult
@@ -91,7 +92,13 @@ def uneven_layout(problem: Problem, k: int, n_x: int, itemsize: int = 4):
     return best
 
 
-def _validate(problem: Problem, k: int, n_x: int, n_y: int = 1):
+def _validate(problem: Problem, k: int, n_x: int, n_y: int = 1,
+              c2tau2_field=None, compute_errors: bool = True):
+    if c2tau2_field is not None and compute_errors:
+        raise ValueError(
+            "variable-c runs have no analytic oracle; pass "
+            "compute_errors=False with c2tau2_field"
+        )
     if k < 2:
         raise ValueError(f"k must be >= 2 (got {k})")
     if n_x < 1 or n_y < 1:
@@ -144,6 +151,7 @@ def _make_runner(
     start_step: Optional[int],
     block_x: Optional[int],
     interpret: bool,
+    has_field: bool = False,
 ):
     """One jitted program: [bootstrap +] k-block scan + 1-step remainder.
 
@@ -157,6 +165,11 @@ def _make_runner(
     an int builds the resume program re-entering at that layer.  Both use
     the same local march so the per-layer op sequence is identical (the
     bitwise-resume invariant, solver/kfused.py).
+
+    With `has_field` the c^2tau^2 field rides as an extra P("x","y")
+    runtime argument; being time-invariant, its y extension and x-ghost
+    exchange are hoisted OUT of the layer scan (once per solve per
+    needed ghost depth: k for the blocks, 1 for bootstrap/remainder).
     """
     n_x, n_y = shard_grid
     f = stencil_ref.compute_dtype(dtype)
@@ -186,11 +199,25 @@ def _make_runner(
         hi = lax.ppermute(a[:, :depth], "y", perm_bwd_y)
         return jnp.concatenate([lo, a, hi], axis=1)
 
-    def kcall(syz_c, rsyz_c, u_prev, u, sxct_k, kk, with_errors, bxo):
+    def field_pack(fld, kk):
+        """(block_or_ext, x-ghost pair) of the time-invariant field at
+        ghost depth kk - built once per solve, outside the scan."""
+        if fld is None:
+            return None
+        if n_y == 1:
+            return fld, ghosts(fld, kk)
+        fe = extend_y(fld, kk)
+        return fe, ghosts(fe, kk)
+
+    def kcall(syz_c, rsyz_c, u_prev, u, sxct_k, kk, with_errors, bxo,
+              fp=None):
+        c2b = fp[0] if fp is not None else None
+        c2g = fp[1] if fp is not None else None
         if n_y == 1:
             return stencil_pallas.fused_kstep_sharded(
                 u_prev, u, ghosts(u_prev, kk), ghosts(u, kk), syz_c,
                 rsyz_c, sxct_k, k=kk, coeff=coeff, inv_h2=problem.inv_h2,
+                c2tau2_block=c2b, c2_ghosts=c2g,
                 block_x=bxo, interpret=interpret, with_errors=with_errors,
             )
         pe = extend_y(u_prev, kk)
@@ -199,7 +226,8 @@ def _make_runner(
         up, uc, dm, rm = stencil_pallas.fused_kstep_sharded_xy(
             pe, ce, ghosts(pe, kk), ghosts(ce, kk), syz_c, rsyz_c,
             sxct_k, y0, problem.N, k=kk, nl_y=nl_y, coeff=coeff,
-            inv_h2=problem.inv_h2, block_x=bxo, interpret=interpret,
+            inv_h2=problem.inv_h2, c2tau2_ext=c2b, c2_ghosts=c2g,
+            block_x=bxo, interpret=interpret,
             with_errors=with_errors,
         )
         if with_errors:
@@ -216,17 +244,19 @@ def _make_runner(
             r = lax.pmax(r, "y")
         return d, r
 
-    def local_march(syz_c, rsyz_c, u_prev, u, sxct_loc, first):
+    def local_march(syz_c, rsyz_c, u_prev, u, sxct_loc, first, fld=None):
         """Layers first+1..nsteps; returns carry + (rows_d, rows_r) for
         exactly nsteps - first layers."""
         rows_d, rows_r = [], []
+        fp_k = field_pack(fld, k)
+        fp_1 = field_pack(fld, 1) if rem else None
 
         def body(carry, nstart):
             u_prev, u = carry
             sxct_k = lax.dynamic_slice(sxct_loc, (nstart + 1, 0), (k, nl))
             up, uc, dm, rm = kcall(
                 syz_c, rsyz_c, u_prev, u, sxct_k, k, compute_errors,
-                block_x,
+                block_x, fp_k,
             )
             if not compute_errors:
                 dm = rm = jnp.zeros((k, nl), f)
@@ -240,7 +270,8 @@ def _make_runner(
             layer = nsteps - rem + 1 + t
             sxct_1 = lax.dynamic_slice(sxct_loc, (layer, 0), (1, nl))
             u_prev, u, dm, rm = kcall(
-                syz_c, rsyz_c, u_prev, u, sxct_1, 1, compute_errors, None
+                syz_c, rsyz_c, u_prev, u, sxct_1, 1, compute_errors, None,
+                fp_1,
             )
             if not compute_errors:
                 dm = rm = jnp.zeros((1, nl), f)
@@ -252,14 +283,19 @@ def _make_runner(
     rows_spec = P(None, "x")
     plane_spec = P("y", None)
 
+    field_specs = (state_spec,) if has_field else ()
+
     if start_step is None:
 
-        def local(u0, sxct_loc, syz_c, rsyz_c):
+        def local(u0, sxct_loc, syz_c, rsyz_c, *fargs):
+            fld = fargs[0] if has_field else None
             # kcall returns (layer n+k-1, layer n+k, ...): the stepped
-            # field u0 + C*lap(u0) is the SECOND output.
+            # field u0 + C*lap(u0) is the SECOND output.  With a field
+            # the same identity holds per point (s0 = u0 + c^2tau^2*lap),
+            # so the bootstrap needs no half-field.
             _, s0, _, _ = kcall(
                 syz_c, rsyz_c, u0, u0, jnp.zeros((1, nl), f), 1, False,
-                None,
+                None, field_pack(fld, 1),
             )
             u1 = (0.5 * (u0.astype(f) + s0.astype(f))).astype(dtype)
             if compute_errors:
@@ -267,7 +303,7 @@ def _make_runner(
             else:
                 d1 = r1 = jnp.zeros((1, nl), f)
             u_prev, u, rows_d, rows_r = local_march(
-                syz_c, rsyz_c, u0, u1, sxct_loc, 1
+                syz_c, rsyz_c, u0, u1, sxct_loc, 1, fld
             )
             zero = jnp.zeros((1, nl), f)
             return (
@@ -276,21 +312,24 @@ def _make_runner(
                 jnp.concatenate([zero, r1, rows_r]),
             )
 
-        local_fn = jax.shard_map(
+        local_fn = compat.shard_map(
             local, mesh=mesh,
-            in_specs=(state_spec, rows_spec, plane_spec, plane_spec),
+            in_specs=(state_spec, rows_spec, plane_spec, plane_spec)
+            + field_specs,
             out_specs=(state_spec, state_spec, rows_spec, rows_spec),
             # vma inference cannot see through the pallas kernel's mixed
             # ghost/wraparound concat (same workaround as solver/timing.py)
             check_vma=False,
         )
 
-        def run():
+        def run(*fargs):
             u0 = lax.with_sharding_constraint(
                 leapfrog.initial_layer0(problem, dtype),
                 NamedSharding(mesh, state_spec),
             )
-            u_prev, u, dmax, rmax = local_fn(u0, sxct_all, syz, rsyz)
+            u_prev, u, dmax, rmax = local_fn(
+                u0, sxct_all, syz, rsyz, *fargs
+            )
             if compute_errors:
                 abs_e, rel_e = _assemble_errors(oracle_parts, dmax, rmax)
             else:
@@ -299,9 +338,10 @@ def _make_runner(
 
         return jax.jit(run), ()
 
-    def local_resume(u_prev, u, sxct_loc, syz_c, rsyz_c):
+    def local_resume(u_prev, u, sxct_loc, syz_c, rsyz_c, *fargs):
         u_prev, u, rows_d, rows_r = local_march(
-            syz_c, rsyz_c, u_prev, u, sxct_loc, start_step
+            syz_c, rsyz_c, u_prev, u, sxct_loc, start_step,
+            fargs[0] if has_field else None,
         )
         head = jnp.zeros((start_step + 1, nl), f)
         return (
@@ -310,16 +350,17 @@ def _make_runner(
             jnp.concatenate([head, rows_r]),
         )
 
-    local_fn = jax.shard_map(
+    local_fn = compat.shard_map(
         local_resume, mesh=mesh,
         in_specs=(state_spec, state_spec, rows_spec, plane_spec,
-                  plane_spec),
+                  plane_spec) + field_specs,
         out_specs=(state_spec, state_spec, rows_spec, rows_spec),
         check_vma=False,
     )
 
-    def run(u_prev, u):
-        u_prev, u, dmax, rmax = local_fn(u_prev, u, sxct_all, syz, rsyz)
+    def run(u_prev, u, *fargs):
+        u_prev, u, dmax, rmax = local_fn(u_prev, u, sxct_all, syz, rsyz,
+                                         *fargs)
         if compute_errors:
             abs_e, rel_e = _assemble_errors(oracle_parts, dmax, rmax)
         else:
@@ -340,6 +381,7 @@ def _make_padded_runner(
     start_step: Optional[int],
     block_x: Optional[int],
     interpret: bool,
+    has_field: bool = False,
 ):
     """Pad-and-mask x-only runner for uneven decompositions.
 
@@ -361,6 +403,11 @@ def _make_padded_runner(
     per step).  Measured on v5e at N=510/1000 k=4: 26.9 Gcell/s vs 44.9
     for the even point-to-point path and 20.3 for the 1-step kernel -
     the fallback is still a clear win over not fusing.
+
+    With `has_field` the c^2tau^2 field arrives zero-padded to the
+    (MX*D, N, N) layout as an extra P("x") runtime argument; its
+    extended form (lo ghosts | D | hi spliced, zero junk) is assembled
+    ONCE per solve per ghost depth with exactly the state's machinery.
     """
     f = stencil_ref.compute_dtype(dtype)
     n = problem.N
@@ -402,8 +449,9 @@ def _make_padded_runner(
             lax.axis_index("x") == n_x - 1, r, d
         ).astype(jnp.int32)
 
-    def ghosts(up, uc, kk):
-        """True cyclic real-plane ghosts, stacked (2, kk, N, N).
+    def ghosts_of(both, kk):
+        """True cyclic real-plane ghosts of the leading-stacked fields
+        (shape (F, D, N, N)).
 
         lo = the kk real planes globally preceding this shard's start,
         hi = the kk real planes following its real end.  When the last
@@ -411,7 +459,6 @@ def _make_padded_runner(
         shards; the static r makes the piece sizes static, so two extra
         two-hop ppermutes + concats assemble them.
         """
-        both = jnp.stack([up, uc])
         if not multi:
             lo = lax.dynamic_slice_in_dim(both, r - kk, kk, 1)
             hi = lax.slice_in_dim(both, 0, kk, axis=1)
@@ -435,6 +482,18 @@ def _make_padded_runner(
             hi = jnp.where(ai == n_x - 2, him, hi)
         return lo, hi
 
+    def ghosts(up, uc, kk):
+        return ghosts_of(jnp.stack([up, uc]), kk)
+
+    def field_ext(fld, nm, kk):
+        """The field's (D + 2kk, N, N) extended array - same lo-ghost /
+        hi-splice / zero-junk layout as the state ext, assembled once per
+        solve (the field is time-invariant)."""
+        if fld is None:
+            return None
+        lo, hi = ghosts_of(fld[None], kk)
+        return build_ext(fld, lo[0], hi[0], nm, kk)
+
     def build_ext(field, lo_f, hi_f, nm, kk):
         ny, nz = field.shape[1], field.shape[2]
         ext = jnp.concatenate(
@@ -445,28 +504,33 @@ def _make_padded_runner(
             ext, hi_f, (jnp.int32(kk) + nm, z, z)
         )
 
-    def kcall(syz_c, rsyz_c, up, uc, sxct_k, kk, with_err):
+    def kcall(syz_c, rsyz_c, up, uc, sxct_k, kk, with_err, ec2=None):
         nm = nm_scalar()
         lo, hi = ghosts(up, uc, kk)
         ep = build_ext(up, lo[0], hi[0], nm, kk)
         ec = build_ext(uc, lo[1], hi[1], nm, kk)
         return stencil_pallas.fused_kstep_padded(
             ep, ec, nm, syz_c, rsyz_c, sxct_k, k=kk, coeff=coeff,
-            inv_h2=problem.inv_h2, block_x=bx, interpret=interpret,
-            with_errors=with_err,
+            inv_h2=problem.inv_h2, ext_c2=ec2, block_x=bx,
+            interpret=interpret, with_errors=with_err,
         )
 
     def layer_rows(syz_c, rsyz_c, u, sxct_row):
         return kfused._layer_rows_local(u, sxct_row, syz_c, rsyz_c, f)
 
-    def local_march(syz_c, rsyz_c, u_prev, u, sxct_loc, first):
+    def local_march(syz_c, rsyz_c, u_prev, u, sxct_loc, first, fld=None):
         rows_d, rows_r = [], []
+        nm = nm_scalar()
+        ec2_k = field_ext(fld, nm, k)
+        ec2_1 = field_ext(fld, nm, 1) if (fld is not None and rem) \
+            else None
 
         def body(carry, nstart):
             u_prev, u = carry
             sxct_k = lax.dynamic_slice(sxct_loc, (nstart + 1, 0), (k, d))
             up, uc, dm, rm = kcall(
-                syz_c, rsyz_c, u_prev, u, sxct_k, k, compute_errors
+                syz_c, rsyz_c, u_prev, u, sxct_k, k, compute_errors,
+                ec2_k,
             )
             if not compute_errors:
                 dm = rm = jnp.zeros((k, d), f)
@@ -480,7 +544,8 @@ def _make_padded_runner(
             layer = nsteps - rem + 1 + t
             sxct_1 = lax.dynamic_slice(sxct_loc, (layer, 0), (1, d))
             u_prev, u, dm, rm = kcall(
-                syz_c, rsyz_c, u_prev, u, sxct_1, 1, compute_errors
+                syz_c, rsyz_c, u_prev, u, sxct_1, 1, compute_errors,
+                ec2_1,
             )
             if not compute_errors:
                 dm = rm = jnp.zeros((1, d), f)
@@ -498,11 +563,15 @@ def _make_padded_runner(
         z = jnp.zeros((nsteps + 1,), f)
         return z, z
 
+    field_specs = (state_spec,) if has_field else ()
+
     if start_step is None:
 
-        def local(u0, sxct_loc, syz_c, rsyz_c):
+        def local(u0, sxct_loc, syz_c, rsyz_c, *fargs):
+            fld = fargs[0] if has_field else None
             _, s0, _, _ = kcall(
-                syz_c, rsyz_c, u0, u0, jnp.zeros((1, d), f), 1, False
+                syz_c, rsyz_c, u0, u0, jnp.zeros((1, d), f), 1, False,
+                field_ext(fld, nm_scalar(), 1),
             )
             u1 = (0.5 * (u0.astype(f) + s0.astype(f))).astype(dtype)
             if compute_errors:
@@ -510,7 +579,7 @@ def _make_padded_runner(
             else:
                 d1 = r1 = jnp.zeros((1, d), f)
             u_prev, u, rows_d, rows_r = local_march(
-                syz_c, rsyz_c, u0, u1, sxct_loc, 1
+                syz_c, rsyz_c, u0, u1, sxct_loc, 1, fld
             )
             zero = jnp.zeros((1, d), f)
             return (
@@ -519,14 +588,15 @@ def _make_padded_runner(
                 jnp.concatenate([zero, r1, rows_r]),
             )
 
-        local_fn = jax.shard_map(
+        local_fn = compat.shard_map(
             local, mesh=mesh,
-            in_specs=(state_spec, rows_spec, plane_spec, plane_spec),
+            in_specs=(state_spec, rows_spec, plane_spec, plane_spec)
+            + field_specs,
             out_specs=(state_spec, state_spec, rows_spec, rows_spec),
             check_vma=False,
         )
 
-        def run():
+        def run(*fargs):
             u0 = jnp.pad(
                 leapfrog.initial_layer0(problem, dtype),
                 ((0, pad), (0, 0), (0, 0)),
@@ -534,15 +604,18 @@ def _make_padded_runner(
             u0 = lax.with_sharding_constraint(
                 u0, NamedSharding(mesh, state_spec)
             )
-            u_prev, u, dmax, rmax = local_fn(u0, sxct_all, syz, rsyz)
+            u_prev, u, dmax, rmax = local_fn(
+                u0, sxct_all, syz, rsyz, *fargs
+            )
             abs_e, rel_e = assemble(dmax, rmax)
             return u_prev, u, abs_e, rel_e
 
         return jax.jit(run), (dg, pad)
 
-    def local_resume(u_prev, u, sxct_loc, syz_c, rsyz_c):
+    def local_resume(u_prev, u, sxct_loc, syz_c, rsyz_c, *fargs):
         u_prev, u, rows_d, rows_r = local_march(
-            syz_c, rsyz_c, u_prev, u, sxct_loc, start_step
+            syz_c, rsyz_c, u_prev, u, sxct_loc, start_step,
+            fargs[0] if has_field else None,
         )
         head = jnp.zeros((start_step + 1, d), f)
         return (
@@ -551,16 +624,17 @@ def _make_padded_runner(
             jnp.concatenate([head, rows_r]),
         )
 
-    local_fn = jax.shard_map(
+    local_fn = compat.shard_map(
         local_resume, mesh=mesh,
         in_specs=(state_spec, state_spec, rows_spec, plane_spec,
-                  plane_spec),
+                  plane_spec) + field_specs,
         out_specs=(state_spec, state_spec, rows_spec, rows_spec),
         check_vma=False,
     )
 
-    def run(u_prev, u):
-        u_prev, u, dmax, rmax = local_fn(u_prev, u, sxct_all, syz, rsyz)
+    def run(u_prev, u, *fargs):
+        u_prev, u, dmax, rmax = local_fn(u_prev, u, sxct_all, syz, rsyz,
+                                         *fargs)
         abs_e, rel_e = assemble(dmax, rmax)
         return u_prev, u, abs_e, rel_e
 
@@ -612,38 +686,57 @@ def solve_sharded_kfused(
     interpret: Optional[bool] = None,
     devices: Optional[Sequence[jax.Device]] = None,
     mesh_shape: Optional[Tuple[int, int, int]] = None,
+    c2tau2_field=None,
 ) -> SolveResult:
     """k-fused solve over an (MX, MY, 1) mesh; reference timing phases as
     `leapfrog.solve`.  `n_shards` is the x-only shorthand (MX, 1, 1);
     `mesh_shape` selects a 2D decomposition (defaults to all devices on
-    the x axis)."""
+    the x axis).  `c2tau2_field` threads the variable-c slab through the
+    sharded onion (sharded on the same mesh, k-deep ghost planes
+    exchanged once per solve; compute_errors=False required)."""
     if devices is None:
         devices = jax.devices()
     n_x, n_y = _resolve_grid(mesh_shape, n_shards, devices)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    _validate(problem, k, n_x, n_y)
+    _validate(problem, k, n_x, n_y, c2tau2_field, compute_errors)
     nsteps = problem.timesteps if stop_step is None else stop_step
     if not 1 <= nsteps <= problem.timesteps:
         raise ValueError(
             f"stop_step must be in [1, {problem.timesteps}], got {nsteps}"
         )
     mesh = build_mesh((n_x, n_y, 1), devices[: n_x * n_y])
+    has_field = c2tau2_field is not None
+    f = stencil_ref.compute_dtype(dtype)
+    run_params = ()
     if _is_even(problem, k, n_x):
         runner, _ = _make_runner(
             problem, mesh, (n_x, n_y), dtype, k, compute_errors, nsteps,
-            None, block_x, interpret,
+            None, block_x, interpret, has_field,
         )
         sliced = False
+        if has_field:
+            run_params = (jax.device_put(
+                jnp.asarray(c2tau2_field, dtype=f),
+                NamedSharding(mesh, P("x", "y")),
+            ),)
     else:
-        runner, _ = _make_padded_runner(
+        runner, (dg, _) = _make_padded_runner(
             problem, mesh, n_x, dtype, k, compute_errors, nsteps,
-            None, block_x, interpret,
+            None, block_x, interpret, has_field,
         )
         sliced = True
+        if has_field:
+            fld = jnp.pad(
+                jnp.asarray(c2tau2_field, dtype=f),
+                ((0, dg - problem.N), (0, 0), (0, 0)),
+            )
+            run_params = (jax.device_put(
+                fld, NamedSharding(mesh, P("x"))
+            ),)
     (u_prev, u_cur, abs_all, rel_all), init_s, solve_s = (
         leapfrog._timed_compile_run(
-            runner, (), sync=lambda out: np.asarray(out[2])
+            runner, run_params, sync=lambda out: np.asarray(out[2])
         )
     )
     if sliced:
@@ -675,19 +768,22 @@ def resume_sharded_kfused(
     interpret: Optional[bool] = None,
     devices: Optional[Sequence[jax.Device]] = None,
     mesh_shape: Optional[Tuple[int, int, int]] = None,
+    c2tau2_field=None,
 ) -> SolveResult:
     """Re-enter the sharded k-fused march at layer `start_step`.
 
     `u_prev`/`u_cur` may be global jax.Arrays (a live sharded result) or
     host arrays (a loaded checkpoint); they are placed P("x", "y") on the
-    mesh (see `solve_sharded_kfused` for the mesh parameters).
+    mesh (see `solve_sharded_kfused` for the mesh parameters).  A
+    variable-c checkpoint resumes under the same re-passed
+    `c2tau2_field` (checkpoints store state, not the field).
     """
     if devices is None:
         devices = jax.devices()
     n_x, n_y = _resolve_grid(mesh_shape, n_shards, devices)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    _validate(problem, k, n_x, n_y)
+    _validate(problem, k, n_x, n_y, c2tau2_field, compute_errors)
     nsteps = problem.timesteps
     if not 1 <= start_step <= nsteps:
         raise ValueError(
@@ -695,20 +791,26 @@ def resume_sharded_kfused(
         )
     mesh = build_mesh((n_x, n_y, 1), devices[: n_x * n_y])
     sliced = not _is_even(problem, k, n_x)
+    has_field = c2tau2_field is not None
+    f = stencil_ref.compute_dtype(dtype)
     if not sliced:
         runner, _ = _make_runner(
             problem, mesh, (n_x, n_y), dtype, k, compute_errors, nsteps,
-            start_step, block_x, interpret,
+            start_step, block_x, interpret, has_field,
         )
         sharding = NamedSharding(mesh, P("x", "y"))
         args = (
             jax.device_put(jnp.asarray(u_prev, dtype), sharding),
             jax.device_put(jnp.asarray(u_cur, dtype), sharding),
         )
+        if has_field:
+            args = args + (jax.device_put(
+                jnp.asarray(c2tau2_field, dtype=f), sharding
+            ),)
     else:
         runner, (dg, _) = _make_padded_runner(
             problem, mesh, n_x, dtype, k, compute_errors, nsteps,
-            start_step, block_x, interpret,
+            start_step, block_x, interpret, has_field,
         )
         sharding = NamedSharding(mesh, P("x"))
         padw = ((0, dg - problem.N), (0, 0), (0, 0))
@@ -722,6 +824,11 @@ def resume_sharded_kfused(
                 sharding,
             ),
         )
+        if has_field:
+            args = args + (jax.device_put(
+                jnp.pad(jnp.asarray(c2tau2_field, dtype=f), padw),
+                sharding,
+            ),)
     (u_p, u_c, abs_all, rel_all), init_s, solve_s = (
         leapfrog._timed_compile_run(
             runner, args, sync=lambda out: np.asarray(out[2])

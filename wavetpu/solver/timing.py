@@ -46,6 +46,7 @@ from jax.sharding import PartitionSpec as P
 
 from wavetpu.core.grid import AXIS_NAMES, Topology, build_mesh, choose_mesh_shape
 from wavetpu.core.problem import Problem
+from wavetpu import compat
 from wavetpu.kernels import stencil_ref
 from wavetpu.solver import sharded as _sharded
 
@@ -89,7 +90,7 @@ def _probe_runner(problem: Problem, topo: Topology, mesh, dtype, kernel,
 
     spec = P(*AXIS_NAMES)
     return jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             local,
             mesh=mesh,
             in_specs=(spec, spec, P("x"), P("y"), P("z"), P()),
@@ -185,11 +186,91 @@ def _kfused_probe_runner(problem, grid, mesh, dtype, k, interpret,
     state_spec = P("x", "y")
     plane_spec = P("y", None)
     return jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             local,
             mesh=mesh,
             in_specs=(state_spec, state_spec, plane_spec, plane_spec,
                       P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+
+
+def _kfused_comp_probe_runner(problem, grid, mesh, dtype, v_dtype,
+                              carry_dtype, k, interpret, with_halo,
+                              iters: int):
+    """`_kfused_probe_runner` for the velocity-form compensated onion
+    (solver/kfused_comp.py): the scan carries (u, v, carry) and both u
+    and v exchange k-deep ghosts per block (the carry stays shard-local,
+    exactly as in production).  `with_halo=False` substitutes local wrap
+    planes/rows for every ppermute - identical FLOPs and kernel, no ICI.
+    `carry_dtype=None` probes the carry-less increment form (the bf16-v
+    mode)."""
+    from wavetpu.kernels import stencil_pallas as _sp
+
+    n_x, n_y = grid
+    f = stencil_ref.compute_dtype(dtype)
+    nl = problem.N // n_x
+    nl_y = problem.N // n_y
+    carry_on = carry_dtype is not None
+    perm_fwd = [(i, (i + 1) % n_x) for i in range(n_x)]
+    perm_bwd = [(i, (i - 1) % n_x) for i in range(n_x)]
+    perm_fwd_y = [(i, (i + 1) % n_y) for i in range(n_y)]
+    perm_bwd_y = [(i, (i - 1) % n_y) for i in range(n_y)]
+
+    def local(u, v, carry, syz_c, rsyz_c, salt):
+        def ghosts(a):
+            if with_halo:
+                return (
+                    lax.ppermute(a[-k:], "x", perm_fwd),
+                    lax.ppermute(a[:k], "x", perm_bwd),
+                )
+            return a[-k:], a[:k]
+
+        def extend_y(a):
+            if with_halo:
+                lo = lax.ppermute(a[:, -k:], "y", perm_fwd_y)
+                hi = lax.ppermute(a[:, :k], "y", perm_bwd_y)
+            else:
+                lo, hi = a[:, -k:], a[:, :k]
+            return jnp.concatenate([lo, a, hi], axis=1)
+
+        def body(state, _):
+            u, v, c = state
+            if n_y == 1:
+                u2, v2, c2, _, _ = _sp.fused_kstep_comp_sharded(
+                    u, v, c, ghosts(u), ghosts(v), syz_c, rsyz_c,
+                    jnp.zeros((k, nl), f), k=k, coeff=problem.a2tau2,
+                    inv_h2=problem.inv_h2, interpret=interpret,
+                    with_errors=False,
+                )
+            else:
+                ue, ve = extend_y(u), extend_y(v)
+                y0 = lax.axis_index("y") * nl_y
+                u2, v2, c2, _, _ = _sp.fused_kstep_comp_sharded_xy(
+                    ue, ve, c, ghosts(ue), ghosts(ve), syz_c, rsyz_c,
+                    jnp.zeros((k, nl), f), y0, problem.N, k=k,
+                    nl_y=nl_y, coeff=problem.a2tau2,
+                    inv_h2=problem.inv_h2, interpret=interpret,
+                    with_errors=False,
+                )
+            return (u2, v2, c2), None
+
+        (u, v, carry), _ = jax.lax.scan(
+            body, (u + salt, v, carry), None, length=iters
+        )
+        return jax.lax.psum(jnp.sum(u), AXIS_NAMES)
+
+    state_spec = P("x", "y")
+    plane_spec = P("y", None)
+    return jax.jit(
+        compat.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(state_spec, state_spec,
+                      state_spec if carry_on else None,
+                      plane_spec, plane_spec, P()),
             out_specs=P(),
             check_vma=False,
         )
@@ -207,6 +288,8 @@ def measure_phase_breakdown(
     iters: int = 10,
     repeats: int = 3,
     fuse_steps: int = 1,
+    scheme: str = "standard",
+    v_dtype=None,
 ) -> PhaseBreakdown:
     """Measure the loop/exchange split and scale it to the full solve length.
 
@@ -215,7 +298,11 @@ def measure_phase_breakdown(
     step the production solver would run; `fuse_steps > 1` probes the
     sharded k-fused program instead (any even (MX, MY, 1) decomposition;
     `iters` then counts k-blocks and the breakdown is scaled by the
-    layers they cover).
+    layers they cover).  `scheme="compensated"` with `fuse_steps > 1`
+    probes the velocity-form onion - (u, v, carry) state, u AND v
+    exchanging ghosts - including the carry-less bf16-increment mode via
+    `v_dtype=bfloat16` (the 1-step compensated scheme has no probe; the
+    CLI rejects that combination).
     """
     if devices is None:
         devices = jax.devices()
@@ -243,25 +330,46 @@ def measure_phase_breakdown(
         f = stencil_ref.compute_dtype(dtype)
         _, _, syz, rsyz, _, _ = _kfused._oracle_parts(problem, f)
         sharding = jax.sharding.NamedSharding(mesh, P("x", "y"))
-        u_prev = jax.device_put(
-            jnp.zeros((problem.N,) * 3, dtype), sharding
-        )
-        u = jax.device_put(jnp.zeros((problem.N,) * 3, dtype), sharding)
-        args = (u_prev, u, syz, rsyz)
-        t_full = _time_best(
-            _kfused_probe_runner(
-                problem, (n_x, n_y), mesh, dtype, k, interpret, True,
-                iters,
-            ),
-            args, repeats,
-        )
-        t_comp = _time_best(
-            _kfused_probe_runner(
-                problem, (n_x, n_y), mesh, dtype, k, interpret, False,
-                iters,
-            ),
-            args, repeats,
-        )
+        if scheme == "compensated":
+            from wavetpu.solver import kfused_comp as _kc
+
+            vd = jnp.dtype(dtype) if v_dtype is None else jnp.dtype(
+                v_dtype)
+            carry_on = vd != jnp.bfloat16 or jnp.dtype(
+                dtype) == jnp.bfloat16
+            cd = _kc._default_carry_dtype(dtype) if carry_on else None
+            u = jax.device_put(
+                jnp.zeros((problem.N,) * 3, dtype), sharding
+            )
+            v = jax.device_put(jnp.zeros((problem.N,) * 3, vd), sharding)
+            carry = (
+                jax.device_put(jnp.zeros((problem.N,) * 3, cd), sharding)
+                if carry_on else None
+            )
+            args = (u, v, carry, syz, rsyz)
+
+            def runner(with_halo):
+                return _kfused_comp_probe_runner(
+                    problem, (n_x, n_y), mesh, dtype, vd, cd, k,
+                    interpret, with_halo, iters,
+                )
+        else:
+            u_prev = jax.device_put(
+                jnp.zeros((problem.N,) * 3, dtype), sharding
+            )
+            u = jax.device_put(
+                jnp.zeros((problem.N,) * 3, dtype), sharding
+            )
+            args = (u_prev, u, syz, rsyz)
+
+            def runner(with_halo):
+                return _kfused_probe_runner(
+                    problem, (n_x, n_y), mesh, dtype, k, interpret,
+                    with_halo, iters,
+                )
+
+        t_full = _time_best(runner(True), args, repeats)
+        t_comp = _time_best(runner(False), args, repeats)
         scale = problem.timesteps / (iters * k)
         return PhaseBreakdown(
             loop_seconds=t_comp * scale,
