@@ -97,6 +97,60 @@ def _run(tag, fn, errors_computed=True, best_of=2, bytes_per_cell=None):
     return row, best
 
 
+def _supervised_row(problem, head, interp):
+    """One supervised run of the headline config (k=4 velocity-form
+    compensated onion) with 4 checkpoint boundaries + the watchdog on.
+
+    Records the supervisor's overhead (checkpoint writes + fused health
+    reductions + rotation GC) against the unsupervised headline's best
+    solve time: `overhead_pct` must stay <= 5 for the robustness layer to
+    be considered free at production scale.  Single run (the checkpoint
+    IO dominates variance, and best-of-2 would hide exactly the cost this
+    row exists to watch)."""
+    import shutil
+    import tempfile
+    import traceback
+
+    from wavetpu.run import supervisor as sup
+
+    root = tempfile.mkdtemp(prefix="wavetpu-bench-ckpt-")
+    try:
+        spec = sup.PathSpec(
+            backend="single", scheme="compensated", fuse_steps=4,
+            kernel="pallas", interpret=interp,
+        )
+        opts = sup.SupervisorOptions(
+            ckpt_every=max(1, problem.timesteps // 4), ckpt_dir=root,
+        )
+        out = sup.supervise(problem, spec, opts)
+        res = out.result
+        wall = res.solve_seconds + out.overhead_seconds
+        overhead_pct = None
+        if head.get("solve_seconds"):
+            overhead_pct = round(
+                100.0 * (wall - head["solve_seconds"])
+                / head["solve_seconds"], 2,
+            )
+        return {
+            "gcells_per_s": round(res.gcells_per_second, 3),
+            "max_abs_error": float(res.abs_errors.max()),
+            "solve_seconds": round(res.solve_seconds, 3),
+            "supervised_wall_seconds": round(wall, 3),
+            "overhead_seconds": round(out.overhead_seconds, 3),
+            "overhead_pct_vs_headline": overhead_pct,
+            "checkpoints": out.checkpoints_written,
+            "status": out.status,
+            "policy": "best_of_1",
+            "config": "kfused_comp_k4 + ckpt-every T/4 + watchdog",
+        }
+    except Exception:
+        print("supervised sub-benchmark failed:", file=sys.stderr)
+        traceback.print_exc()
+        return {"error": "failed; see stderr"}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def main() -> int:
     import jax
     import jax.numpy as jnp
@@ -324,6 +378,12 @@ def main() -> int:
             bytes_per_cell=14,
         ),
     }
+
+    # Supervised headline: the flagship config under run/supervisor.py
+    # (periodic checkpoints + per-chunk watchdog) so robustness features
+    # cannot silently regress perf - overhead is recorded as a % of the
+    # unsupervised headline wall time and the acceptance bar is <= 5%.
+    subs["supervised"] = _supervised_row(problem, head, interp)
     line = {
         "metric": "gcell_updates_per_s",
         "value": head["gcells_per_s"],
@@ -364,6 +424,9 @@ def main() -> int:
         "solve_seconds": head["solve_seconds"],
         "config": line["config"],
         "kfused_varc_gcells_per_s": varc_row.get("gcells_per_s"),
+        "supervised_overhead_pct": subs["supervised"].get(
+            "overhead_pct_vs_headline"
+        ),
         "headline_summary": True,
     }
     print(json.dumps(summary))

@@ -624,4 +624,7 @@ def test_cli_json_run_config(tmp_path, capsys):
         "c2_field": None,
         "distributed": False,
         "resumed": False,
+        "supervised": False,
+        "ckpt_every": None,
+        "supervisor_status": None,
     }

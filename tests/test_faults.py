@@ -1,0 +1,199 @@
+"""Fault-injection drills (run/faults.py): every corruption-rejection
+branch in io/checkpoint.py + io/nativeio.py actually fires, the stale-tmp
+cleanup satellite holds, and the CLI's supervised exit-code contract
+(0/2/3/4) survives injected faults - no injected fault ever produces a
+completed-looking result."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from wavetpu import cli
+from wavetpu.core.problem import Problem
+from wavetpu.io import checkpoint
+from wavetpu.run import faults
+from wavetpu.solver import leapfrog, sharded
+
+
+@pytest.fixture(scope="module")
+def sharded_ckpt_state(tmp_path_factory):
+    """One tiny sharded half-run checkpoint shared by the on-disk fault
+    drills (each test re-copies it so the faults stay independent)."""
+    p = Problem(N=16, timesteps=6)
+    res = sharded.solve_sharded(
+        p, mesh_shape=(2, 1, 1), kernel="roll", stop_step=3
+    )
+    d = tmp_path_factory.mktemp("ck") / "ck"
+    checkpoint.save_sharded_checkpoint(str(d), res)
+    return p, res, str(d)
+
+
+def _copy_dir(src, dst):
+    import shutil
+
+    shutil.copytree(src, dst)
+    return str(dst)
+
+
+def _first_shard(d):
+    return os.path.join(
+        d, sorted(f for f in os.listdir(d) if f.endswith(".wts"))[0]
+    )
+
+
+def test_bitflip_rejected_by_crc(sharded_ckpt_state, tmp_path):
+    _, _, src = sharded_ckpt_state
+    d = _copy_dir(src, tmp_path / "flip")
+    faults.flip_byte(_first_shard(d))
+    with pytest.raises(ValueError, match="CRC mismatch"):
+        checkpoint.load_sharded_checkpoint(d)
+
+
+def test_truncated_wts_rejected(sharded_ckpt_state, tmp_path):
+    _, _, src = sharded_ckpt_state
+    d = _copy_dir(src, tmp_path / "trunc")
+    faults.truncate_tail(_first_shard(d), drop_bytes=64)
+    with pytest.raises(ValueError, match="truncated checkpoint"):
+        checkpoint.load_sharded_checkpoint(d)
+
+
+def test_stale_step_shard_rejected(sharded_ckpt_state, tmp_path):
+    """A CRC-VALID shard carrying an older step than meta (the
+    interrupted save-over-older-checkpoint) is rejected as mixed-step -
+    the CRC branch must not be the only line of defense."""
+    _, _, src = sharded_ckpt_state
+    d = _copy_dir(src, tmp_path / "stale")
+    faults.rewrite_shard_step(d, new_step=2)
+    with pytest.raises(ValueError, match="interrupted mid-save"):
+        checkpoint.load_sharded_checkpoint(d)
+
+
+def test_stale_wts_with_good_legacy_falls_back(sharded_ckpt_state,
+                                               tmp_path):
+    """The WTS/legacy mixed-step fallback: when the stale WTS shard sits
+    next to a legacy .npz shard that DOES carry meta's step, the loader
+    assembles from the legacy file instead of failing."""
+    p, res, src = sharded_ckpt_state
+    d = _copy_dir(src, tmp_path / "legacy")
+    shard = os.path.basename(_first_shard(d))
+    starts = shard[len("shard_"):-len(".wts")]
+    # Write the legacy twin with the CORRECT step from the real state.
+    from wavetpu.io import nativeio
+
+    fields, meta = nativeio.read_container(os.path.join(d, shard))
+    np.savez(
+        os.path.join(d, f"shard_{starts}.npz"),
+        step=meta["step"],
+        **{k: a for k, (a, _) in fields.items()},
+    )
+    faults.rewrite_shard_step(d, new_step=1, shard_name=shard)
+    _, u_prev, u_cur, step, _, _, _ = checkpoint.load_sharded_checkpoint(d)
+    assert step == 3
+    np.testing.assert_array_equal(
+        np.asarray(u_cur), np.asarray(res.u_cur)
+    )
+
+
+def test_truncated_npz_resume_is_clean_cli_error(small_problem, tmp_path,
+                                                 capsys):
+    half = leapfrog.solve(small_problem, stop_step=3)
+    path = checkpoint.save_checkpoint(str(tmp_path / "ck.npz"), half)
+    faults.truncate_tail(path, drop_bytes=256)
+    assert cli.main(["--resume", path]) == 2
+    assert "cannot load checkpoint" in capsys.readouterr().err
+
+
+def test_save_cleans_stale_tmps_and_load_ignores_them(
+    sharded_ckpt_state, tmp_path
+):
+    """A crashed writer's `*.tmp-<pid>*` debris neither survives the next
+    save into the directory nor confuses the loader."""
+    p, res, src = sharded_ckpt_state
+    d = _copy_dir(src, tmp_path / "tmps")
+    shard = os.path.basename(_first_shard(d))
+    stale = [
+        os.path.join(d, f"{shard}.tmp-99999"),
+        os.path.join(d, "meta.npz.tmp-99999.npz"),
+    ]
+    for s in stale:
+        with open(s, "wb") as f:
+            f.write(b"\0" * 64)
+    # The loader opens exact filenames only: debris is ignored.
+    _, _, u_cur, step, _, _, _ = checkpoint.load_sharded_checkpoint(d)
+    assert step == 3
+    # The next save into the directory removes its files' stale temps.
+    checkpoint.save_sharded_checkpoint(d, res)
+    for s in stale:
+        assert not os.path.exists(s), s
+
+
+def test_cli_supervised_exit_codes_and_resume(tmp_path, capsys,
+                                              monkeypatch):
+    """The full CLI drill: env-injected preemption -> exit 3 with the
+    resumable path printed; --resume of the rotation root completes with
+    the uninterrupted run's error tail; an env-injected NaN -> exit 4;
+    supervised flags are validated."""
+    base = ["16", "1", "1", "1", "1", "1", "10", "--backend", "single"]
+    full_dir = str(tmp_path / "full")
+    assert cli.main(base + ["--out-dir", full_dir]) == 0
+    rot = str(tmp_path / "rot")
+    monkeypatch.setenv(faults.ENV_FAULT, "preempt:5")
+    rc = cli.main(
+        base + ["--ckpt-every", "3", "--ckpt-dir", rot,
+                "--out-dir", str(tmp_path / "pre")]
+    )
+    assert rc == 3
+    out = capsys.readouterr().out
+    assert "resumable checkpoint:" in out
+    monkeypatch.delenv(faults.ENV_FAULT)
+    # Resume THE ROTATION ROOT (the latest pointer resolves inside).
+    rc = cli.main(
+        ["--resume", rot, "--ckpt-every", "3",
+         "--out-dir", str(tmp_path / "res")]
+    )
+    assert rc == 0
+    capsys.readouterr()
+    full = json.load(
+        open(os.path.join(full_dir, "output_N16_Np1_TPU.json"))
+    )
+    res = json.load(
+        open(os.path.join(str(tmp_path / "res"),
+                          "output_N16_Np1_TPU.json"))
+    )
+    assert res["abs_errors"][8:] == full["abs_errors"][8:]
+    assert res["run_config"]["supervised"] is True
+    # Injected NaN: watchdog halt, never a completed-looking exit 0.
+    monkeypatch.setenv(faults.ENV_FAULT, "nan:5")
+    rc = cli.main(
+        base + ["--ckpt-every", "3", "--ckpt-dir",
+                str(tmp_path / "rot4"),
+                "--out-dir", str(tmp_path / "wd")]
+    )
+    assert rc == 4
+    assert "watchdog" in capsys.readouterr().out
+    monkeypatch.delenv(faults.ENV_FAULT)
+    # Flag validation: supervised options demand --ckpt-every; a
+    # supervised run cannot also --stop-step; --ckpt-every needs a dir.
+    assert cli.main(base + ["--retries", "2"]) == 2
+    assert cli.main(
+        base + ["--ckpt-every", "3", "--ckpt-dir", rot,
+                "--stop-step", "5"]
+    ) == 2
+    assert cli.main(base + ["--ckpt-every", "3"]) == 2
+    assert cli.main(base + ["--ckpt-every", "0", "--ckpt-dir", rot]) == 2
+    capsys.readouterr()
+
+
+def test_cli_watchdog_catches_unstable_config(tmp_path, capsys):
+    """A genuinely Courant-unstable run (no injection at all) trips the
+    amplitude guard instead of reporting a garbage error norm."""
+    rc = cli.main(
+        ["16", "1", "1", "1", "1", "10", "10", "--backend", "single",
+         "--ckpt-every", "4", "--ckpt-dir", str(tmp_path / "rot"),
+         "--out-dir", str(tmp_path)]
+    )
+    assert rc == 4
+    out = capsys.readouterr().out
+    assert "watchdog: numerical-health trip" in out

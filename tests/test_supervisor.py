@@ -1,0 +1,333 @@
+"""Supervised solves (run/supervisor.py): chunked march == uninterrupted
+march BITWISE on every wrapped path, checkpoint rotation with `latest`
+pointer + keep-2 GC, real-signal preemption + resume, watchdog halt on
+injected NaN with the last-good checkpoint preserved, and bounded
+auto-retry - driven by the fault harness (run/faults.py), never by
+timing races."""
+
+import os
+
+import numpy as np
+import pytest
+
+from wavetpu.core.problem import Problem
+from wavetpu.io import checkpoint
+from wavetpu.run import faults, health
+from wavetpu.run import supervisor as sup
+from wavetpu.solver import kfused, kfused_comp, leapfrog
+
+
+def _opts(tmp_path, every=3, **kw):
+    return sup.SupervisorOptions(
+        ckpt_every=every, ckpt_dir=str(tmp_path / "rot"), **kw
+    )
+
+
+def _eq(a, b):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_chunk_length_snaps_to_block():
+    assert sup.chunk_length(5, 1) == 5
+    assert sup.chunk_length(5, 4) == 4    # snapped down to one block
+    assert sup.chunk_length(8, 4) == 8
+    assert sup.chunk_length(1, 4) == 4    # at least one block
+    with pytest.raises(ValueError):
+        sup.chunk_length(0, 1)
+
+
+def test_supervised_standard_bitwise_and_rotation(small_problem, tmp_path):
+    full = leapfrog.solve(small_problem)
+    r = sup.supervise(small_problem, sup.PathSpec(), _opts(tmp_path))
+    assert r.status == "complete" and r.exit_code == sup.EXIT_COMPLETE
+    _eq(r.result.u_cur, full.u_cur)
+    _eq(r.result.u_prev, full.u_prev)
+    np.testing.assert_array_equal(r.result.abs_errors, full.abs_errors)
+    np.testing.assert_array_equal(r.result.rel_errors, full.rel_errors)
+    # Rotation layout: fresh step entries, keep-last-2 GC, atomic pointer.
+    root = tmp_path / "rot"
+    entries = sorted(
+        e for e in os.listdir(root) if e.startswith("step-")
+    )
+    assert r.checkpoints_written == 3          # boundaries 4, 7, 10
+    assert len(entries) == 2                   # GC kept the newest two
+    assert entries[-1] == "step-00000010.npz"
+    assert open(root / "latest").read().strip() == entries[-1]
+    assert sup.resolve_latest(str(root)) == str(root / entries[-1])
+    assert sup.looks_like_rotation_root(str(root))
+
+
+def test_resolve_latest_survives_lost_pointer(small_problem, tmp_path):
+    sup.supervise(small_problem, sup.PathSpec(), _opts(tmp_path))
+    root = tmp_path / "rot"
+    os.remove(root / "latest")
+    # Pointer lost to a crash: fall back to the newest step entry.
+    assert sup.resolve_latest(str(root)).endswith("step-00000010.npz")
+    # A per-shard checkpoint directory itself is NOT a rotation root.
+    os.makedirs(tmp_path / "shardck")
+    np.savez(tmp_path / "shardck" / "meta.npz", step=1)
+    assert not sup.looks_like_rotation_root(str(tmp_path / "shardck"))
+
+
+def test_supervised_kfused_preempt_resume_bitwise(tmp_path):
+    """SIGTERM mid-solve (a REAL signal, delivered by the fault harness)
+    followed by --resume of `latest` == uninterrupted run, bitwise, on
+    the standard k-fused path - including the 1-step remainder tail."""
+    p = Problem(N=12, timesteps=10)
+    full = kfused.solve_kfused(p, k=2, interpret=True)
+    spec = sup.PathSpec(fuse_steps=2, kernel="pallas", interpret=True)
+    r = sup.supervise(
+        p, spec,
+        _opts(tmp_path, every=4, chunk_hook=faults.preempt_at_step(5)),
+    )
+    assert r.status == "preempted" and r.exit_code == sup.EXIT_PREEMPTED
+    assert r.checkpoint_path is not None
+    _, u_prev, u_cur, step = checkpoint.load_checkpoint(r.checkpoint_path)
+    assert step == r.final_step < p.timesteps
+    r2 = sup.supervise(
+        p, spec, _opts(tmp_path, every=4),
+        state=(u_prev, u_cur), start_step=step,
+    )
+    assert r2.status == "complete"
+    _eq(r2.result.u_cur, full.u_cur)
+    _eq(r2.result.u_prev, full.u_prev)
+    np.testing.assert_array_equal(
+        r2.result.abs_errors[step + 1:], full.abs_errors[step + 1:]
+    )
+
+
+def test_supervised_kfused_comp_preempt_resume_bitwise(tmp_path):
+    """The same SIGTERM + resume drill on the compensated k-fused
+    (velocity-form onion) path: supervision must preserve its exact
+    trajectory, carry included."""
+    p = Problem(N=12, timesteps=9)
+    full = kfused_comp.solve_kfused_comp(p, k=2, interpret=True)
+    spec = sup.PathSpec(
+        scheme="compensated", fuse_steps=2, kernel="pallas",
+        interpret=True,
+    )
+    r = sup.supervise(
+        p, spec,
+        _opts(tmp_path, every=4, chunk_hook=faults.preempt_at_step(5)),
+    )
+    assert r.status == "preempted"
+    latest = sup.resolve_latest(str(tmp_path / "rot"))
+    _, _, u_cur, step = checkpoint.load_checkpoint(latest)
+    v, carry = checkpoint.load_checkpoint_aux(latest)
+    r2 = sup.supervise(
+        p, spec, _opts(tmp_path, every=4),
+        state=(u_cur, v, carry), start_step=step,
+    )
+    assert r2.status == "complete"
+    _eq(r2.result.u_cur, full.u_cur)
+    _eq(r2.result.comp_v, full.comp_v)
+    _eq(r2.result.comp_carry, full.comp_carry)
+
+
+def test_supervised_compensated_1step_bitwise(small_problem, tmp_path):
+    full = leapfrog.solve_compensated(small_problem)
+    spec = sup.PathSpec(scheme="compensated")
+    r = sup.supervise(small_problem, spec, _opts(tmp_path, every=4))
+    assert r.status == "complete"
+    _eq(r.result.u_cur, full.u_cur)
+    _eq(r.result.comp_v, full.comp_v)
+    _eq(r.result.comp_carry, full.comp_carry)
+
+
+def test_supervised_variable_c_bitwise(tmp_path):
+    from wavetpu.kernels import stencil_ref
+
+    p = Problem(N=12, timesteps=8)
+    field = stencil_ref.make_c2tau2_field(
+        p, lambda x, y, z: np.where(z < 0.5, p.a2, 0.5 * p.a2)
+        + 0.0 * x + 0.0 * y,
+    )
+    full = leapfrog.solve(
+        p, step_fn=stencil_ref.make_variable_c_step(field),
+        compute_errors=False,
+    )
+    spec = sup.PathSpec(c2tau2_field=field, compute_errors=False)
+    r = sup.supervise(p, spec, _opts(tmp_path))
+    assert r.status == "complete"
+    _eq(r.result.u_cur, full.u_cur)
+
+
+def test_watchdog_halts_with_last_good(small_problem, tmp_path):
+    """An injected NaN never reaches a completed-looking result: the run
+    halts with exit code 4, the LAST-GOOD state, and its checkpoint."""
+    full = leapfrog.solve(small_problem)
+    r = sup.supervise(
+        small_problem, sup.PathSpec(),
+        _opts(tmp_path, chunk_hook=faults.nan_at_step(7)),
+    )
+    assert r.status == "watchdog" and r.exit_code == sup.EXIT_WATCHDOG
+    assert r.amax_last == float("inf")
+    assert r.final_step == 4                     # boundary before the trip
+    good = leapfrog.solve(small_problem, stop_step=4)
+    _eq(r.result.u_cur, good.u_cur)
+    # Errors beyond the last-good step are zeroed, not garbage.
+    np.testing.assert_array_equal(
+        r.result.abs_errors[:5], full.abs_errors[:5]
+    )
+    assert np.all(r.result.abs_errors[5:] == 0.0)
+    # The preserved checkpoint resumes to the uninterrupted result.
+    _, u_prev, u_cur, step = checkpoint.load_checkpoint(r.checkpoint_path)
+    assert step == 4
+    res = leapfrog.resume(small_problem, u_prev, u_cur, start_step=step)
+    _eq(res.u_cur, full.u_cur)
+
+
+def test_watchdog_retry_recovers_bitwise(small_problem, tmp_path):
+    """--retries N: a transient injected fault is absorbed by reloading
+    the last-good checkpoint, and the final state is still bitwise-equal
+    to the uninterrupted run."""
+    full = leapfrog.solve(small_problem)
+    r = sup.supervise(
+        small_problem, sup.PathSpec(),
+        _opts(tmp_path, retries=1, chunk_hook=faults.nan_at_step(7)),
+    )
+    assert r.status == "complete" and r.retries_used == 1
+    _eq(r.result.u_cur, full.u_cur)
+    np.testing.assert_array_equal(r.result.abs_errors, full.abs_errors)
+
+
+def test_resume_into_fresh_rotation_seeds_last_good(small_problem,
+                                                    tmp_path):
+    """Resuming an external checkpoint into an EMPTY rotation root seeds
+    it with the injected state, so a trip in the first post-resume chunk
+    retries from the resume point - never a silent restart from layer 0
+    (and a halt still reports the injected step, not step 0)."""
+    full = leapfrog.solve(small_problem)
+    half = leapfrog.solve(small_problem, stop_step=5)
+    ck = checkpoint.save_checkpoint(str(tmp_path / "ext.npz"), half)
+    _, u_prev, u_cur, step = checkpoint.load_checkpoint(ck)
+    r = sup.supervise(
+        small_problem, sup.PathSpec(),
+        _opts(tmp_path, retries=1, chunk_hook=faults.nan_at_step(6)),
+        state=(u_prev, u_cur), start_step=step,
+    )
+    assert r.status == "complete" and r.retries_used == 1
+    _eq(r.result.u_cur, full.u_cur)
+    # steps marched = (10 - 5) + the retried chunk, never the full 10+.
+    assert r.result.steps_computed <= 2 * (small_problem.timesteps - 5)
+    # The halt flavor: no retries -> last good IS the injected step.
+    r2 = sup.supervise(
+        small_problem, sup.PathSpec(),
+        sup.SupervisorOptions(
+            ckpt_every=3, ckpt_dir=str(tmp_path / "rot2"),
+            chunk_hook=faults.nan_at_step(6),
+        ),
+        state=(u_prev, u_cur), start_step=step,
+    )
+    assert r2.status == "watchdog" and r2.final_step == 5
+    _eq(r2.result.u_cur, half.u_cur)
+    assert r2.checkpoint_path is not None
+
+
+def test_watchdog_amplitude_bound(small_problem, tmp_path):
+    """A finite-but-blown-up amplitude trips the bound (not just NaN)."""
+    r = sup.supervise(
+        small_problem, sup.PathSpec(),
+        _opts(tmp_path, max_amp=1e-4),
+    )
+    assert r.status == "watchdog"
+    assert np.isfinite(r.amax_last) and r.amax_last > 1e-4
+
+
+def test_health_guard_semantics():
+    import jax.numpy as jnp
+
+    assert health.guarded_amax(jnp.asarray([1.0, -3.0])) == 3.0
+    assert health.guarded_amax(
+        jnp.asarray([1.0, float("nan")])
+    ) == float("inf")
+    assert health.guarded_amax(
+        jnp.asarray([1.0, float("inf")])
+    ) == float("inf")
+    assert health.healthy(0.5) and not health.healthy(float("inf"))
+    assert not health.healthy(float("nan"))
+
+
+def test_supervised_sharded_standard_bitwise(small_problem, tmp_path):
+    """Sharded (dryrun-mesh) supervision: chunked shard_map march ==
+    uninterrupted sharded solve, bitwise, and the rotation holds
+    per-shard checkpoint DIRECTORIES."""
+    from wavetpu.solver import sharded
+
+    full = sharded.solve_sharded(
+        small_problem, mesh_shape=(2, 1, 1), kernel="roll"
+    )
+    spec = sup.PathSpec(
+        backend="sharded", kernel="roll", mesh_shape=(2, 1, 1)
+    )
+    r = sup.supervise(small_problem, spec, _opts(tmp_path, every=4))
+    assert r.status == "complete"
+    _eq(r.result.u_cur, full.u_cur)
+    np.testing.assert_array_equal(r.result.abs_errors, full.abs_errors)
+    assert os.path.isdir(r.checkpoint_path)
+    assert os.path.exists(os.path.join(r.checkpoint_path, "meta.npz"))
+
+
+@pytest.mark.heavy
+def test_supervised_sharded_kfused_preempt_resume(tmp_path):
+    """The dryrun-mesh k-fused drill: preempt a sharded k-fused
+    supervised run with a real SIGTERM, resume the per-shard `latest`
+    checkpoint, land bitwise on the uninterrupted run."""
+    from wavetpu.solver import sharded_kfused
+
+    p = Problem(N=12, timesteps=9)
+    full = sharded_kfused.solve_sharded_kfused(
+        p, mesh_shape=(2, 1, 1), k=2, interpret=True
+    )
+    spec = sup.PathSpec(
+        backend="sharded", fuse_steps=2, kernel="pallas",
+        mesh_shape=(2, 1, 1), interpret=True,
+    )
+    r = sup.supervise(
+        p, spec,
+        _opts(tmp_path, every=4, chunk_hook=faults.preempt_at_step(5)),
+    )
+    assert r.status == "preempted"
+    (_, u_prev, u_cur, step, mesh_shape, _, _) = (
+        checkpoint.load_sharded_checkpoint(r.checkpoint_path)
+    )
+    assert mesh_shape == (2, 1, 1)
+    r2 = sup.supervise(
+        p, spec, _opts(tmp_path, every=4),
+        state=(u_prev, u_cur), start_step=step,
+    )
+    assert r2.status == "complete"
+    _eq(r2.result.u_cur, full.u_cur)
+
+
+@pytest.mark.heavy
+def test_supervised_sharded_kfused_comp_bitwise(tmp_path):
+    """Supervised distributed velocity-form flagship (dryrun mesh)."""
+    p = Problem(N=12, timesteps=9)
+    full = kfused_comp.solve_kfused_comp_sharded(
+        p, mesh_shape=(2, 1, 1), k=2, interpret=True
+    )
+    spec = sup.PathSpec(
+        backend="sharded", scheme="compensated", fuse_steps=2,
+        kernel="pallas", mesh_shape=(2, 1, 1), interpret=True,
+    )
+    r = sup.supervise(p, spec, _opts(tmp_path, every=4))
+    assert r.status == "complete"
+    _eq(r.result.u_cur, full.u_cur)
+    _eq(r.result.comp_v, full.comp_v)
+
+
+@pytest.mark.heavy
+def test_supervised_uneven_kfused_bitwise(tmp_path):
+    """The pad-and-mask route (k does not divide N) under supervision."""
+    from wavetpu.solver import sharded_kfused
+
+    p = Problem(N=15, timesteps=8)
+    full = sharded_kfused.solve_sharded_kfused(
+        p, n_shards=1, k=2, interpret=True
+    )
+    spec = sup.PathSpec(fuse_steps=2, kernel="pallas", interpret=True)
+    r = sup.supervise(p, spec, _opts(tmp_path))
+    assert r.status == "complete"
+    _eq(r.result.u_cur, full.u_cur)
+    np.testing.assert_array_equal(r.result.abs_errors, full.abs_errors)

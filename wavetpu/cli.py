@@ -110,7 +110,40 @@ pick at runtime):
   --resume PATH                     continue a checkpointed run to its
                                     timesteps (positionals then unnecessary);
                                     a directory resumes on the sharded
-                                    backend, a .npz on the single-device one
+                                    backend, a .npz on the single-device one.
+                                    A checkpoint ROTATION root (what
+                                    --ckpt-dir maintains) resolves through
+                                    its `latest` pointer automatically, so
+                                    `--resume DIR --ckpt-every S` composes
+                                    across repeated preemptions
+  --ckpt-every S                    SUPERVISED solve (run/supervisor.py):
+                                    march in ~S-layer chunks (snapped to the
+                                    --fuse-steps block so supervised layers
+                                    stay bitwise-identical), checkpointing
+                                    each boundary into a fresh rotation
+                                    entry under --ckpt-dir with an atomic
+                                    `latest` pointer and keep-last-2 GC;
+                                    SIGTERM/SIGINT finish the chunk, save,
+                                    and exit resumable (code 3); each chunk
+                                    is health-checked (run/health.py) and a
+                                    NaN/amplitude blowup halts with the
+                                    last-good checkpoint (code 4)
+  --ckpt-dir DIR                    the rotation root for --ckpt-every
+                                    (defaults to the --resume rotation root
+                                    when resuming one)
+  --retries N                       bounded auto-retry: reload the last-good
+                                    checkpoint after a watchdog trip and
+                                    re-run the chunk up to N times (the
+                                    transient-fault model) before halting
+  --max-amp X                       watchdog amplitude bound (default 1e3;
+                                    the analytic solution is |u| <= 1, so
+                                    the default only trips real blowups)
+  --no-watchdog                     disable the per-chunk health check
+
+Exit codes (docs/robustness.md): 0 complete; 2 usage or checkpoint-load
+error; 3 preempted but checkpointed (requeue + --resume); 4 numerical-
+health halt with the last-good checkpoint preserved (page an operator).
+Non-zero supervised exits print `resumable checkpoint: PATH`.
 """
 
 from __future__ import annotations
@@ -126,9 +159,11 @@ _KNOWN_FLAGS = (
     "phase-timing", "stop-step", "save-state", "resume",
     "kernel", "overlap", "scheme", "distributed", "profile",
     "fuse-steps", "debug-nans", "v-dtype", "c2-field",
+    "ckpt-every", "ckpt-dir", "retries", "max-amp", "no-watchdog",
 )
 _VALUELESS = (
     "no-errors", "phase-timing", "overlap", "distributed", "debug-nans",
+    "no-watchdog",
 )
 
 
@@ -245,6 +280,36 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             raise ValueError("--mesh contradicts --backend single")
         if flags.get("backend") == "single" and "overlap" in flags:
             raise ValueError("--overlap applies to the sharded backend")
+        supervised = "ckpt-every" in flags
+        if supervised:
+            ckpt_every = int(flags["ckpt-every"])
+            if ckpt_every < 1:
+                raise ValueError(
+                    f"--ckpt-every must be >= 1, got {ckpt_every}"
+                )
+            if "stop-step" in flags:
+                raise ValueError(
+                    "--ckpt-every supervises the run to completion; it "
+                    "is exclusive with --stop-step (preempt a supervised "
+                    "run with SIGTERM instead)"
+                )
+        else:
+            for dep in ("ckpt-dir", "retries", "max-amp", "no-watchdog"):
+                if dep in flags:
+                    raise ValueError(
+                        f"--{dep} requires --ckpt-every S (the "
+                        f"supervised-solve mode)"
+                    )
+        sup_retries = int(flags.get("retries", "0"))
+        if sup_retries < 0:
+            raise ValueError(f"--retries must be >= 0, got {sup_retries}")
+        sup_max_amp = (
+            float(flags["max-amp"]) if "max-amp" in flags else None
+        )
+        if sup_max_amp is not None and not sup_max_amp > 0:
+            raise ValueError(
+                f"--max-amp must be > 0, got {sup_max_amp}"
+            )
         if "resume" in flags:
             if "stop-step" in flags:
                 raise ValueError("--resume and --stop-step are exclusive")
@@ -270,6 +335,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "[--overlap] [--no-errors] [--phase-timing] [--profile DIR] "
             "[--debug-nans] [--distributed] [--stop-step S] "
             "[--save-state PATH] [--resume PATH] "
+            "[--ckpt-every S] [--ckpt-dir DIR] [--retries N] "
+            "[--max-amp X] [--no-watchdog] "
             "[--out-dir DIR] [--platform NAME]",
             file=sys.stderr,
         )
@@ -277,11 +344,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     resume_state = None
     resume_is_sharded = False
+    rotation_root = None
     if "resume" in flags:
         import os as _os
 
         from wavetpu.io import checkpoint as _ckpt
+        from wavetpu.run import supervisor as _sup
 
+        if _sup.looks_like_rotation_root(flags["resume"]):
+            # A --ckpt-dir rotation root: follow its `latest` pointer to
+            # the newest checkpoint (and remember the root so a
+            # supervised resume keeps rotating in place).
+            rotation_root = flags["resume"]
+            resolved = _sup.resolve_latest(rotation_root)
+            if resolved is None:
+                print(
+                    f"error: {rotation_root} holds no resumable "
+                    f"checkpoint",
+                    file=sys.stderr,
+                )
+                return 2
+            flags["resume"] = resolved
         resume_is_sharded = _os.path.isdir(flags["resume"])
         try:
             if resume_is_sharded:
@@ -617,7 +700,119 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             dtype if "dtype" in flags else jnp.dtype(_u_cur0.dtype)
         )
 
-    if backend == "sharded" and fuse_steps > 1 and scheme == "compensated":
+    sup_out = None
+    if supervised:
+        # Supervised solve (run/supervisor.py): every solver path below
+        # has a supervised twin - chunked march through cached chunk
+        # programs, rotating checkpoints, watchdog, signal handling.
+        from wavetpu.run import supervisor as _sup
+
+        ckpt_dir = flags.get("ckpt-dir") or rotation_root
+        if not ckpt_dir:
+            print(
+                "error: --ckpt-every needs --ckpt-dir DIR (or --resume "
+                "of an existing rotation root)",
+                file=sys.stderr,
+            )
+            return 2
+        spec_vdtype = None
+        spec_carry = True
+        sup_state = None
+        sup_start = None
+        sup_mesh = mesh_shape
+        sup_dtype = dtype
+        if scheme == "compensated" and fuse_steps > 1 and \
+                "resume" not in flags:
+            v_bf16 = flags.get("v-dtype") == "bf16"
+            spec_vdtype = jnp.bfloat16 if v_bf16 else None
+            spec_carry = not v_bf16
+        def _comp_resume_state(u_cur0, aux, st_dtype):
+            # Shared bf16-increment detection: a bf16 v stream beside a
+            # non-bf16 carrier marks the carry-less increment form
+            # (k-fused only); the sidecar must record the mode that ran.
+            _v, _c = aux
+            inc = (
+                fuse_steps > 1
+                and jnp.dtype(_v.dtype) == jnp.bfloat16
+                and jnp.dtype(st_dtype) != jnp.bfloat16
+            )
+            if inc:
+                flags["v-dtype"] = "bf16"
+            return (
+                (u_cur0, _v, None if inc else _c),
+                jnp.bfloat16 if inc else None,
+                not inc,
+            )
+
+        if "resume" in flags:
+            if resume_is_sharded:
+                sup_dtype = resume_dtype
+                sup_mesh = _ck_mesh
+                sup_start = _start
+                if scheme == "compensated":
+                    sup_state, spec_vdtype, spec_carry = (
+                        _comp_resume_state(_u_cur0, _ck_aux, sup_dtype)
+                    )
+                else:
+                    sup_state = (_u_prev0, _u_cur0)
+            else:
+                u_prev0, u_cur0, sup_start = resume_state
+                sup_dtype = (
+                    dtype if "dtype" in flags
+                    else jnp.dtype(u_cur0.dtype)
+                )
+                if scheme == "compensated":
+                    sup_state, spec_vdtype, spec_carry = (
+                        _comp_resume_state(u_cur0, _ck_aux, sup_dtype)
+                    )
+                else:
+                    sup_state = (u_prev0, u_cur0)
+        if backend == "sharded":
+            if sup_mesh is None and fuse_steps > 1:
+                sup_mesh = (n_devices, 1, 1)
+            if sup_mesh is None:
+                from wavetpu.core.grid import choose_mesh_shape
+
+                shape = choose_mesh_shape(n_devices)
+            else:
+                shape = sup_mesh
+            n_procs = shape[0] * shape[1] * shape[2]
+        else:
+            sup_mesh = None
+            n_procs = 1
+        variant = "TPU"
+        spec = _sup.PathSpec(
+            backend=backend,
+            scheme=scheme,
+            fuse_steps=fuse_steps,
+            kernel=kernel,
+            dtype=sup_dtype,
+            v_dtype=spec_vdtype,
+            carry=spec_carry,
+            mesh_shape=sup_mesh,
+            c2tau2_field=c2_field,
+            compute_errors=compute_errors,
+            overlap=overlap,
+        )
+        opts = _sup.SupervisorOptions(
+            ckpt_every=ckpt_every,
+            ckpt_dir=ckpt_dir,
+            retries=sup_retries,
+            watchdog="no-watchdog" not in flags,
+            max_amp=sup_max_amp,
+        )
+        sup_out = _sup.supervise(
+            problem, spec, opts, state=sup_state, start_step=sup_start
+        )
+        result = sup_out.result
+        say(
+            f"supervisor: {sup_out.status}; "
+            f"{sup_out.checkpoints_written} checkpoint(s), "
+            f"{sup_out.retries_used} retr"
+            f"{'y' if sup_out.retries_used == 1 else 'ies'}, "
+            f"overhead {sup_out.overhead_seconds * 1000:.0f}ms"
+        )
+    elif backend == "sharded" and fuse_steps > 1 and scheme == "compensated":
         # Distributed velocity-form flagship ((MX, 1, 1) meshes).
         from wavetpu.solver import kfused_comp
 
@@ -969,6 +1164,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 "c2_field": flags.get("c2-field"),
                 "distributed": distributed,
                 "resumed": "resume" in flags,
+                "supervised": supervised,
+                "ckpt_every": ckpt_every if supervised else None,
+                "supervisor_status": (
+                    sup_out.status if sup_out is not None else None
+                ),
             },
         )
     say(f"grids initialized in {int(result.init_seconds * 1000)}ms")
@@ -984,6 +1184,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     say(f"throughput: {result.gcells_per_second:.3f} Gcell-updates/s")
     if is_main:
         say(f"report: {path}")
+    if sup_out is not None and sup_out.status != "complete":
+        # Orchestration contract: distinct exit codes (3 = requeue with
+        # --resume, 4 = page an operator) and the resumable path in the
+        # output (docs/robustness.md).
+        if sup_out.status == "preempted":
+            say(f"preempted: checkpointed at step {sup_out.final_step}")
+        else:
+            say(
+                f"watchdog: numerical-health trip "
+                f"(guarded amax {sup_out.amax_last:g}); "
+                f"last good step {sup_out.final_step}"
+            )
+        if sup_out.checkpoint_path:
+            say(f"resumable checkpoint: {sup_out.checkpoint_path}")
+        return sup_out.exit_code
     return 0
 
 

@@ -185,8 +185,13 @@ def save_sharded_checkpoint(path_dir: str, result: SolveResult) -> str:
     an older checkpoint cannot be silently resumed as mixed-step or torn
     state.  (On multi-host, rank 0's meta write is not ordered after other
     hosts' shard writes; a deployment wanting cross-host atomicity should
-    save each checkpoint to a fresh directory and rename at the
-    orchestration layer.)
+    save each checkpoint to a fresh directory and flip a pointer at the
+    orchestration layer - which is exactly what the supervised-run
+    rotation does: run/supervisor.py's CheckpointRotation saves every
+    periodic checkpoint into a fresh `step-XXXXXXXX` entry and atomically
+    updates a `latest` pointer afterwards.)  Stale `*.tmp-<pid>*` files
+    left by a crashed writer are removed before each shard is rewritten
+    (and are ignored by the loader, which opens exact filenames only).
 
     IO path: shards are WTS1 containers streamed by the native async
     writer (io/nativeio.py: C++ background thread, CRC32, atomic rename) -
@@ -211,8 +216,24 @@ def save_sharded_checkpoint(path_dir: str, result: SolveResult) -> str:
     mesh_shape = tuple(int(mesh.shape[n]) for n in AXIS_NAMES)
     os.makedirs(path_dir, exist_ok=True)
 
+    def clean_stale_tmps(filename):
+        # A writer killed mid-save (the preemption case --ckpt-every
+        # exists for) leaves `<file>.tmp-<pid>*` behind; unbounded runs
+        # would leak one per crash into a rotated checkpoint directory.
+        # Each process cleans only the temp names of files IT is about to
+        # write, so a concurrent multi-host save never removes another
+        # live writer's in-flight temp.
+        prefix = f"{filename}.tmp-"
+        for e in os.listdir(path_dir):
+            if e.startswith(prefix):
+                try:
+                    os.remove(os.path.join(path_dir, e))
+                except OSError:
+                    pass
+
     def atomic_savez(filename, **arrays):
         path = os.path.join(path_dir, filename)
+        clean_stale_tmps(filename)
         # np.savez appends .npz to names without it, so the temp name must
         # already carry the suffix for the rename to find it.
         tmp = f"{path}.tmp-{os.getpid()}.npz"
@@ -254,6 +275,7 @@ def save_sharded_checkpoint(path_dir: str, result: SolveResult) -> str:
             if compensated:
                 fields["comp_v"] = _encode_field(aux_by_start[0][starts])
                 fields["comp_carry"] = _encode_field(aux_by_start[1][starts])
+            clean_stale_tmps(_shard_filename(starts))
             in_flight.append(nativeio.write_container(
                 os.path.join(path_dir, _shard_filename(starts)),
                 fields,

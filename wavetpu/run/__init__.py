@@ -1,0 +1,13 @@
+"""Supervised-run subsystem: periodic checkpointing, numerical-health
+watchdog, preemption handling, bounded auto-retry, and the fault-injection
+harness that proves the recovery paths fire.
+
+ * run/supervisor.py - the solve supervisor (chunked march over cached
+   chunk programs, rotating checkpoints, signals, retries, exit codes)
+ * run/health.py     - the cheap fused non-finite/amplitude guard
+ * run/faults.py     - fault injectors (bit-flip, truncation, stale-step
+   shard, NaN-at-step, preempt-at-step) for tests and drills
+
+Modules here stay import-light (no jax at module import) so the CLI can
+parse flags and resolve checkpoint pointers before the backend spins up.
+"""

@@ -1,0 +1,144 @@
+"""Fault-injection harness: break things on purpose, prove recovery fires.
+
+io/checkpoint.py and io/nativeio.py carry carefully written rejection
+branches (CRC footers, truncation checks, mixed-step detection) that no
+test exercised until this module existed: a recovery path that has never
+run is a liability, not a feature.  The injectors below are used by
+tests/test_faults.py and tests/test_supervisor.py to drive every branch:
+
+ * on-disk faults - `flip_byte` (CRC failure), `truncate_tail`
+   (structural truncation), `rewrite_shard_step` (stale-step shard with a
+   VALID CRC, i.e. the mixed-step fallback, not the checksum)
+ * in-flight faults - chunk hooks for run/supervisor.py's fault port:
+   `nan_at_step` (a NaN the watchdog must catch), `preempt_at_step` (a
+   real SIGTERM/SIGINT delivered to this process mid-march - the
+   kill-and-resume drill)
+
+Chunk hooks have signature `hook(state, step) -> state` and run after a
+chunk completes, BEFORE the health check and checkpoint save - exactly
+where a hardware glitch would land.  `hook_from_env` wires the same
+injectors to the `WAVETPU_FAULT` env var ("nan:STEP" | "preempt:STEP")
+so CLI-level tests can drill the full exit-code path of a live process.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from typing import Optional
+
+
+# ---------------------------------------------------------------- on disk
+
+
+def flip_byte(path: str, offset: Optional[int] = None, xor: int = 0x01):
+    """XOR one byte of `path` in place (default: mid-file, where a shard's
+    array payload lives) - the minimal corruption a CRC must catch."""
+    size = os.path.getsize(path)
+    if size == 0:
+        raise ValueError(f"{path} is empty; nothing to flip")
+    if offset is None:
+        offset = size // 2
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ (xor & 0xFF)]))
+    return offset
+
+
+def truncate_tail(path: str, drop_bytes: int = 16) -> int:
+    """Chop `drop_bytes` off the end of `path` (a torn write / full disk /
+    killed writer).  Returns the new size."""
+    size = os.path.getsize(path)
+    new = max(0, size - drop_bytes)
+    with open(path, "r+b") as f:
+        f.truncate(new)
+    return new
+
+
+def rewrite_shard_step(ckpt_dir: str, new_step: int,
+                       shard_name: Optional[str] = None) -> str:
+    """Rewrite one WTS shard of a sharded checkpoint with `new_step` in its
+    meta - CRC-valid but disagreeing with meta.npz, i.e. the stale shard a
+    preempted save-over-older-checkpoint leaves behind.  Returns the shard
+    path."""
+    from wavetpu.io import nativeio
+
+    if shard_name is None:
+        shards = sorted(
+            f for f in os.listdir(ckpt_dir)
+            if f.startswith("shard_") and f.endswith(".wts")
+        )
+        if not shards:
+            raise FileNotFoundError(f"no .wts shards in {ckpt_dir}")
+        shard_name = shards[0]
+    path = os.path.join(ckpt_dir, shard_name)
+    fields, meta = nativeio.read_container(path)
+    meta = dict(meta, step=int(new_step))
+    nativeio.write_container_sync(path, fields, meta)
+    return path
+
+
+# --------------------------------------------------------------- in flight
+
+
+def nan_at_step(step: int, array_index: int = 1, once: bool = True):
+    """Chunk hook: poison one element of state array `array_index` (default
+    1 = u_cur for every path's state convention) with NaN at the first
+    chunk boundary >= `step`.  With `once` (the transient-fault model) the
+    second attempt after an auto-retry reload runs clean."""
+    fired = [False]
+
+    def hook(state, cur_step):
+        if cur_step < step or (once and fired[0]):
+            return state
+        fired[0] = True
+        import jax.numpy as jnp
+
+        state = list(state)
+        a = state[array_index]
+        flat_nan = jnp.ravel(a).at[0].set(float("nan")).reshape(a.shape)
+        state[array_index] = flat_nan.astype(a.dtype)
+        return tuple(state)
+
+    return hook
+
+
+def preempt_at_step(step: int, sig: int = signal.SIGTERM, once: bool = True):
+    """Chunk hook: deliver `sig` to THIS process at the first chunk
+    boundary >= `step` - a deterministic stand-in for the scheduler's
+    preemption notice.  The supervisor's handler must then finish the
+    bookkeeping, save, and exit resumable (exit code 3)."""
+    fired = [False]
+
+    def hook(state, cur_step):
+        if cur_step >= step and not (once and fired[0]):
+            fired[0] = True
+            os.kill(os.getpid(), sig)
+        return state
+
+    return hook
+
+
+ENV_FAULT = "WAVETPU_FAULT"
+
+
+def hook_from_env(env: Optional[dict] = None):
+    """The CLI port of the harness: WAVETPU_FAULT="nan:STEP" or
+    "preempt:STEP" returns the matching chunk hook (None when unset).
+    Lets subprocess/CLI tests drill the watchdog-halt (exit 4) and
+    kill-and-resume (exit 3) paths without timing races."""
+    env = os.environ if env is None else env
+    spec = env.get(ENV_FAULT)
+    if not spec:
+        return None
+    kind, _, at = spec.partition(":")
+    step = int(at)
+    if kind == "nan":
+        return nan_at_step(step)
+    if kind == "preempt":
+        return preempt_at_step(step)
+    raise ValueError(
+        f"{ENV_FAULT}={spec!r}: want 'nan:STEP' or 'preempt:STEP'"
+    )

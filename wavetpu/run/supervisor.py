@@ -1,0 +1,978 @@
+"""Solve supervisor: chunked march with checkpoints, watchdog, signals.
+
+The reference (and every solver entry point below this module) treats a
+solve as one uninterruptible program: it either finishes or loses
+everything since the last manual `--stop-step` save.  Production TPU
+workloads are preemptible by design - long integrations must be
+restartable jobs (the TPU flow-simulation stack of arXiv:2108.11076 runs
+multi-hour solves exactly this way).  This module wraps EVERY solver path
+(standard/compensated, 1-step/k-fused, single/sharded, variable-c) in
+that discipline:
+
+ * **Chunked march.**  The solve runs as chunks of `ckpt_every` layers
+   (snapped down to the k-fusion block size so chunk boundaries sit on
+   the uninterrupted march's block grid - which is what keeps supervised
+   layers bitwise-identical to an unsupervised solve's).  Chunk 1 is the
+   ordinary `solve_*(stop_step=...)` program; every later chunk re-enters
+   through the solver's `make_*chunk_runner` - a fixed-length program
+   taking the start layer as a RUNTIME scalar, compiled ONCE per config
+   and reused for every chunk (no per-chunk retracing; at most one extra
+   compile for a shorter final chunk).
+
+ * **Periodic checkpointing.**  Each chunk boundary saves to a FRESH
+   entry `step-XXXXXXXX[.npz]` under the rotation root, then atomically
+   updates the `latest` pointer file and garbage-collects all but the
+   newest `keep` entries (plus stale `latest.tmp-*` debris).  Fresh
+   directories + pointer rename are exactly the orchestration-layer
+   atomicity `save_sharded_checkpoint`'s multi-host caveat asks for: a
+   preemption mid-save can tear only the entry the pointer does not yet
+   reference.
+
+ * **Numerical-health watchdog.**  After each chunk (and any injected
+   fault - see run/faults.py) the fused guard of run/health.py reduces
+   the state to one scalar per array; a NaN/Inf or amplitude blowup halts
+   the run with the LAST-GOOD step and checkpoint instead of marching
+   garbage to the final layer and reporting it as an error norm.
+
+ * **Preemption.**  SIGTERM/SIGINT set a flag; the supervisor finishes
+   the current chunk, saves, and returns `status="preempted"` (CLI exit
+   code 3 - requeue me).  `--resume <rotation root>` re-enters from the
+   `latest` pointer, and the cycle composes across repeated preemptions.
+
+ * **Bounded auto-retry.**  `retries=N` reloads the last-good checkpoint
+   after a watchdog trip and re-runs the chunk - the transient-fault
+   model (a bit flip, an injected NaN).  A deterministic blowup trips
+   again and exhausts the budget, landing in the watchdog halt (CLI exit
+   code 4 - page me).
+
+Exit-code contract (wavetpu.cli): 0 complete, 2 usage/load error,
+3 preempted-but-checkpointed (resumable), 4 watchdog halt (last-good
+checkpoint preserved).  See docs/robustness.md.
+
+This module stays import-light: jax is imported inside functions so the
+CLI can resolve rotation pointers before the backend exists.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shutil
+import signal
+import threading
+import time
+from typing import Callable, Optional, Tuple
+
+EXIT_COMPLETE = 0
+EXIT_PREEMPTED = 3
+EXIT_WATCHDOG = 4
+
+_STEP_PREFIX = "step-"
+_LATEST = "latest"
+
+
+# ------------------------------------------------------------- rotation
+
+
+def _entry_step(name: str) -> Optional[int]:
+    """The step number of a rotation entry name, else None."""
+    if not name.startswith(_STEP_PREFIX):
+        return None
+    stem = name[len(_STEP_PREFIX):]
+    if stem.endswith(".npz"):
+        stem = stem[:-4]
+    return int(stem) if stem.isdigit() else None
+
+
+def resolve_latest(root: str) -> Optional[str]:
+    """The newest checkpoint under a rotation root (absolute-ish path),
+    or None.  Prefers the atomically updated `latest` pointer; falls back
+    to the highest-numbered `step-*` entry (pointer lost to a crash
+    before any update).  os-only: safe before jax exists."""
+    if not os.path.isdir(root):
+        return None
+    ptr = os.path.join(root, _LATEST)
+    if os.path.exists(ptr):
+        with open(ptr) as f:
+            name = f.read().strip()
+        cand = os.path.join(root, name)
+        if name and os.path.exists(cand):
+            return cand
+    best = None
+    for e in os.listdir(root):
+        s = _entry_step(e)
+        if s is not None and (best is None or s > best[0]):
+            best = (s, e)
+    return os.path.join(root, best[1]) if best else None
+
+
+def looks_like_rotation_root(path: str) -> bool:
+    """True for a checkpoint ROTATION directory (what --resume may name),
+    as opposed to a per-shard checkpoint directory itself (which carries
+    meta.npz at its top level)."""
+    if not os.path.isdir(path):
+        return False
+    if os.path.exists(os.path.join(path, "meta.npz")):
+        return False
+    if os.path.exists(os.path.join(path, _LATEST)):
+        return True
+    return any(_entry_step(e) is not None for e in os.listdir(path))
+
+
+class CheckpointRotation:
+    """Rotating fresh-entry checkpoint writer with `latest` pointer and
+    keep-last-N garbage collection (see module docstring)."""
+
+    def __init__(self, root: str, keep: int = 2, is_main: bool = True):
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        self.root = root
+        self.keep = keep
+        self.is_main = is_main
+        os.makedirs(root, exist_ok=True)
+
+    def entry_path(self, step: int, directory: bool) -> str:
+        name = f"{_STEP_PREFIX}{step:08d}" + ("" if directory else ".npz")
+        return os.path.join(self.root, name)
+
+    def save(self, save_fn: Callable[[str], Optional[str]], step: int,
+             directory: bool) -> str:
+        """Run `save_fn(entry_path)` into a fresh entry, then (on the main
+        process) flip the `latest` pointer and GC old entries."""
+        path = self.entry_path(step, directory)
+        actual = save_fn(path) or path
+        if self.is_main:
+            self._write_latest(os.path.basename(actual))
+            self._gc()
+        return actual
+
+    def latest_path(self) -> Optional[str]:
+        return resolve_latest(self.root)
+
+    def _write_latest(self, name: str) -> None:
+        tmp = os.path.join(self.root, f"{_LATEST}.tmp-{os.getpid()}")
+        with open(tmp, "w") as f:
+            f.write(name + "\n")
+        os.replace(tmp, os.path.join(self.root, _LATEST))
+
+    def _gc(self) -> None:
+        entries = sorted(
+            (s, e)
+            for e in os.listdir(self.root)
+            if (s := _entry_step(e)) is not None
+        )
+        for _, e in entries[:-self.keep]:
+            p = os.path.join(self.root, e)
+            if os.path.isdir(p):
+                shutil.rmtree(p, ignore_errors=True)
+            else:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+        # Stale pointer temp files from a writer killed mid-update.
+        for e in os.listdir(self.root):
+            if e.startswith(f"{_LATEST}.tmp-"):
+                try:
+                    os.remove(os.path.join(self.root, e))
+                except OSError:
+                    pass
+
+
+# ----------------------------------------------------------------- specs
+
+
+@dataclasses.dataclass(frozen=True)
+class PathSpec:
+    """Which solver path to supervise - the resolved form of the CLI's
+    backend/scheme/kernel/fusion flags (see cli.py's dispatch)."""
+
+    backend: str = "single"            # "single" | "sharded"
+    scheme: str = "standard"           # "standard" | "compensated"
+    fuse_steps: int = 1
+    kernel: str = "roll"               # resolved: "roll" | "pallas"
+    dtype: object = None               # jnp dtype; None -> float32
+    v_dtype: object = None             # bf16 increment stream (comp k-fused)
+    carry: bool = True                 # Kahan carry on (comp k-fused)
+    mesh_shape: Optional[Tuple[int, int, int]] = None
+    c2tau2_field: object = None        # host (N,N,N) tau^2 c^2 array
+    compute_errors: bool = True
+    overlap: bool = False
+    interpret: Optional[bool] = None   # None -> auto (not on TPU)
+    block_x: Optional[int] = None
+
+
+@dataclasses.dataclass
+class SupervisorOptions:
+    ckpt_every: int
+    ckpt_dir: str
+    retries: int = 0
+    watchdog: bool = True
+    max_amp: Optional[float] = None    # None -> health.DEFAULT_AMP_BOUND
+    keep: int = 2
+    handle_signals: bool = True
+    chunk_hook: Optional[Callable] = None  # fault port (run/faults.py)
+
+
+@dataclasses.dataclass
+class SupervisedResult:
+    result: object                     # leapfrog.SolveResult
+    status: str                        # "complete"|"preempted"|"watchdog"
+    exit_code: int
+    final_step: int                    # layer result.u_cur holds
+    checkpoint_path: Optional[str]     # resumable path (rotation entry)
+    checkpoints_written: int
+    retries_used: int
+    overhead_seconds: float            # health checks + saves + GC
+    amax_last: Optional[float]         # last watchdog reading
+
+
+# ---------------------------------------------------------------- signals
+
+
+class _SignalGuard:
+    """Install SIGTERM/SIGINT flag handlers for the duration of a
+    supervised march (main thread only; restores the previous handlers on
+    exit).  The first signal sets `triggered` and restores that signal's
+    original handler, so a second delivery regains its default force-kill
+    meaning."""
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = (
+            enabled
+            and threading.current_thread() is threading.main_thread()
+        )
+        self.triggered: Optional[int] = None
+        self._prev = {}
+
+    def __enter__(self):
+        if self.enabled:
+            for s in self.SIGNALS:
+                self._prev[s] = signal.signal(s, self._handle)
+        return self
+
+    def _handle(self, signum, frame):
+        self.triggered = signum
+        import sys
+
+        print(
+            f"wavetpu: received signal {signum}; finishing the current "
+            f"chunk, checkpointing, and exiting resumable",
+            file=sys.stderr,
+        )
+        signal.signal(signum, self._prev[signum])
+
+    def __exit__(self, *exc):
+        if self.enabled:
+            for s, h in self._prev.items():
+                if signal.getsignal(s) == self._handle:
+                    signal.signal(s, h)
+        return False
+
+
+# ------------------------------------------------------------------ path
+
+
+class _Path:
+    """Adapter from a PathSpec to the underlying solver family: first
+    chunk (the ordinary solve program), cached fixed-length chunk
+    runners, state <-> checkpoint conversion."""
+
+    def __init__(self, problem, spec: PathSpec):
+        import jax
+        import jax.numpy as jnp
+
+        from wavetpu.kernels import stencil_ref
+
+        self.problem = problem
+        self.spec = spec
+        self.dtype = jnp.float32 if spec.dtype is None else spec.dtype
+        self.f = stencil_ref.compute_dtype(self.dtype)
+        self.interpret = (
+            jax.default_backend() != "tpu"
+            if spec.interpret is None else spec.interpret
+        )
+        self.compensated = spec.scheme == "compensated"
+        self.k = spec.fuse_steps
+        self.carry_on = spec.carry if (self.compensated and self.k > 1) \
+            else self.compensated
+        self.has_field = spec.c2tau2_field is not None
+        self._jit = {}        # chunk length -> jitted runner
+        self._compiled = {}   # chunk length -> AOT-compiled runner
+        self._field_dev = None
+        self._resolve_kind()
+
+    def _single_field(self):
+        """The variable-c field as ONE committed device array shared by
+        the first-chunk builder and every chunk runner (their internal
+        `jnp.asarray` on a committed array is a no-copy, so the N^3 slab
+        lives in HBM once, not once per compiled program)."""
+        if not self.has_field:
+            return None
+        if self._field_dev is None:
+            import jax.numpy as jnp
+
+            from wavetpu.solver import leapfrog
+
+            self._field_dev = leapfrog.ParamStep.materialize(
+                jnp.asarray(self.spec.c2tau2_field, dtype=self.f)
+            )
+        return self._field_dev
+
+    # -- dispatch ------------------------------------------------------
+
+    def _resolve_kind(self):
+        import jax
+
+        spec, problem = self.spec, self.problem
+        n = problem.N
+        if spec.backend == "single":
+            if self.k <= 1:
+                self.kind = "comp1" if self.compensated else "single1"
+            elif self.compensated:
+                self.kind = "kfused_comp"
+            elif n % self.k == 0:
+                self.kind = "kfused"
+            else:
+                self.kind = "uneven"
+        else:
+            if self.k <= 1:
+                self.kind = "sharded1"
+            elif self.compensated:
+                self.kind = "sharded_kfused_comp"
+            else:
+                from wavetpu.solver import sharded_kfused as sk
+
+                devices = jax.devices()
+                n_x, _ = sk._resolve_grid(spec.mesh_shape, None, devices)
+                self.kind = (
+                    "sharded_kfused" if sk._is_even(problem, self.k, n_x)
+                    else "uneven"
+                )
+        # Mesh/topology objects for the sharded-program kinds ("uneven"
+        # covers the single-device pad-and-mask path too: it runs the
+        # padded sharded runner on a (1,1,1) grid, exactly as cli.py).
+        if self.kind == "sharded1":
+            from wavetpu.solver import sharded
+
+            self.topo, self.mesh = sharded._resolve_mesh(
+                problem, spec.mesh_shape, None
+            )
+        elif self.kind in ("sharded_kfused", "sharded_kfused_comp",
+                           "uneven"):
+            from wavetpu.core.grid import build_mesh
+            from wavetpu.solver import sharded_kfused as sk
+
+            devices = jax.devices()
+            if self.kind == "uneven" and spec.backend == "single":
+                self.grid = (1, 1)
+            else:
+                self.grid = sk._resolve_grid(spec.mesh_shape, None,
+                                             devices)
+            n_x, n_y = self.grid
+            self.mesh = build_mesh((n_x, n_y, 1), devices[: n_x * n_y])
+
+    @property
+    def saves_directory(self) -> bool:
+        """Sharded backends checkpoint per-shard directories; the single
+        backend (including its uneven pad-and-mask route) one .npz."""
+        return self.spec.backend == "sharded"
+
+    # -- first chunk (the ordinary solve program) ----------------------
+
+    def first(self, stop: int):
+        spec = self.spec
+        if self.kind == "single1":
+            from wavetpu.solver import leapfrog
+
+            res = leapfrog.solve(
+                self.problem, dtype=self.dtype,
+                step_fn=self._step_fn(),
+                compute_errors=spec.compute_errors, stop_step=stop,
+            )
+        elif self.kind == "comp1":
+            from wavetpu.solver import leapfrog
+
+            res = leapfrog.solve_compensated(
+                self.problem, dtype=self.dtype,
+                comp_step_fn=self._comp_step_fn(),
+                compute_errors=spec.compute_errors, stop_step=stop,
+            )
+        elif self.kind == "kfused":
+            from wavetpu.solver import kfused
+
+            res = kfused.solve_kfused(
+                self.problem, dtype=self.dtype, k=self.k,
+                compute_errors=spec.compute_errors, stop_step=stop,
+                block_x=spec.block_x, interpret=self.interpret,
+                c2tau2_field=self._single_field(),
+            )
+        elif self.kind == "kfused_comp":
+            from wavetpu.solver import kfused_comp
+
+            res = kfused_comp.solve_kfused_comp(
+                self.problem, dtype=self.dtype, k=self.k,
+                compute_errors=spec.compute_errors, stop_step=stop,
+                block_x=spec.block_x, interpret=self.interpret,
+                v_dtype=spec.v_dtype, carry=spec.carry,
+                c2tau2_field=self._single_field(),
+            )
+        elif self.kind == "sharded1":
+            from wavetpu.solver import sharded
+
+            res = sharded.solve_sharded(
+                self.problem, mesh_shape=spec.mesh_shape,
+                dtype=self.dtype, compute_errors=spec.compute_errors,
+                kernel=spec.kernel, overlap=spec.overlap,
+                interpret=self.interpret,
+                c2tau2_field=spec.c2tau2_field, stop_step=stop,
+                scheme=spec.scheme,
+            )
+        elif self.kind in ("sharded_kfused", "uneven"):
+            from wavetpu.solver import sharded_kfused
+
+            res = sharded_kfused.solve_sharded_kfused(
+                self.problem,
+                n_shards=1 if spec.backend == "single" else None,
+                dtype=self.dtype, k=self.k,
+                compute_errors=spec.compute_errors, stop_step=stop,
+                block_x=spec.block_x, interpret=self.interpret,
+                mesh_shape=(
+                    None if spec.backend == "single" else spec.mesh_shape
+                ),
+                c2tau2_field=spec.c2tau2_field,
+            )
+        else:  # sharded_kfused_comp
+            from wavetpu.solver import kfused_comp
+
+            res = kfused_comp.solve_kfused_comp_sharded(
+                self.problem, dtype=self.dtype, k=self.k,
+                compute_errors=spec.compute_errors, stop_step=stop,
+                block_x=spec.block_x, interpret=self.interpret,
+                v_dtype=spec.v_dtype, carry=spec.carry,
+                mesh_shape=spec.mesh_shape,
+                c2tau2_field=spec.c2tau2_field,
+            )
+        state = self._state_of(res)
+        return (state, res.abs_errors, res.rel_errors,
+                res.init_seconds, res.solve_seconds)
+
+    def _state_of(self, res):
+        if self.compensated:
+            return (res.u_cur, res.comp_v, res.comp_carry)
+        return (res.u_prev, res.u_cur)
+
+    def _step_fn(self):
+        import jax.numpy as jnp
+
+        spec = self.spec
+        if spec.kernel == "pallas":
+            from wavetpu.kernels import stencil_pallas
+
+            return stencil_pallas.make_step_fn(
+                interpret=self.interpret,
+                c2tau2_field=self._single_field(),
+            )
+        if self.has_field:
+            from wavetpu.kernels import stencil_ref
+
+            return stencil_ref.make_variable_c_step(self._single_field())
+        return None
+
+    def _comp_step_fn(self):
+        if self.spec.kernel == "pallas":
+            from wavetpu.kernels import stencil_pallas
+
+            return stencil_pallas.make_compensated_step_fn(
+                interpret=self.interpret
+            )
+        return None
+
+    # -- chunk runners -------------------------------------------------
+
+    def _field_args(self):
+        """The per-call runtime field argument tuple, placed once."""
+        if hasattr(self, "_field_cache"):
+            return self._field_cache
+        args = ()
+        if self.has_field:
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            fld = jnp.asarray(self.spec.c2tau2_field, dtype=self.f)
+            if self.kind in ("single1", "kfused", "kfused_comp"):
+                from wavetpu.solver import leapfrog
+
+                args = (leapfrog.ParamStep.materialize(fld),)
+            elif self.kind == "sharded1":
+                from wavetpu.core.grid import AXIS_NAMES
+                from wavetpu.solver import sharded
+
+                args = (jax.device_put(
+                    jnp.asarray(
+                        sharded.pad_field(self.spec.c2tau2_field,
+                                          self.topo),
+                        dtype=self.f,
+                    ),
+                    NamedSharding(self.mesh, P(*AXIS_NAMES)),
+                ),)
+            elif self.kind == "uneven":
+                dg = self._uneven_layout()[0]
+                args = (jax.device_put(
+                    jnp.pad(fld, ((0, dg - self.problem.N), (0, 0),
+                                  (0, 0))),
+                    NamedSharding(self.mesh, P("x")),
+                ),)
+            else:
+                args = (jax.device_put(
+                    fld, NamedSharding(self.mesh, P("x", "y"))
+                ),)
+        self._field_cache = args
+        return args
+
+    def _uneven_layout(self):
+        from wavetpu.solver import sharded_kfused as sk
+
+        import jax.numpy as jnp
+
+        bx, d, _ = sk.uneven_layout(
+            self.problem, self.k, self.grid[0],
+            jnp.dtype(self.dtype).itemsize,
+        )
+        dg = self.grid[0] * d
+        return dg, dg - self.problem.N
+
+    def _build_runner(self, length: int, state):
+        import jax.numpy as jnp
+
+        spec = self.spec
+        if self.kind == "single1":
+            from wavetpu.solver import leapfrog
+
+            runner, step_params = leapfrog.make_chunk_runner(
+                self.problem, dtype=self.dtype, length=length,
+                step_fn=self._step_fn(),
+                compute_errors=spec.compute_errors,
+            )
+            return runner, (step_params,)
+        if self.kind == "comp1":
+            from wavetpu.solver import leapfrog
+
+            runner = leapfrog.make_comp_chunk_runner(
+                self.problem, dtype=self.dtype, length=length,
+                comp_step_fn=self._comp_step_fn(),
+                compute_errors=spec.compute_errors,
+            )
+            return runner, ()
+        if self.kind == "kfused":
+            from wavetpu.solver import kfused
+
+            runner, run_params = kfused.make_chunk_runner(
+                self.problem, dtype=self.dtype, length=length, k=self.k,
+                compute_errors=spec.compute_errors, block_x=spec.block_x,
+                interpret=self.interpret,
+                c2tau2_field=self._single_field(),
+            )
+            return runner, tuple(run_params)
+        if self.kind == "kfused_comp":
+            from wavetpu.solver import kfused_comp
+
+            runner, run_params = kfused_comp.make_chunk_runner(
+                self.problem, dtype=self.dtype, length=length, k=self.k,
+                compute_errors=spec.compute_errors, block_x=spec.block_x,
+                interpret=self.interpret,
+                v_dtype=jnp.dtype(state[1].dtype), carry=self.carry_on,
+                c2tau2_field=self._single_field(),
+            )
+            return runner, tuple(run_params)
+        if self.kind == "sharded1":
+            from wavetpu.solver import sharded
+
+            runner = sharded.make_sharded_chunk_runner(
+                self.problem, self.topo, self.mesh, length,
+                dtype=self.dtype, compute_errors=spec.compute_errors,
+                kernel=spec.kernel, overlap=spec.overlap,
+                interpret=self.interpret, has_field=self.has_field,
+                scheme=spec.scheme,
+            )
+            return runner, self._field_args()
+        if self.kind in ("sharded_kfused", "uneven"):
+            from wavetpu.solver import sharded_kfused
+
+            runner, _ = sharded_kfused.make_chunk_runner(
+                self.problem, self.mesh, self.grid, dtype=self.dtype,
+                length=length, k=self.k,
+                compute_errors=spec.compute_errors, block_x=spec.block_x,
+                interpret=self.interpret, has_field=self.has_field,
+            )
+            return runner, self._field_args()
+        from wavetpu.solver import kfused_comp
+
+        runner = kfused_comp.make_sharded_chunk_runner(
+            self.problem, self.mesh, self.grid, dtype=self.dtype,
+            length=length, k=self.k,
+            compute_errors=spec.compute_errors, block_x=spec.block_x,
+            interpret=self.interpret,
+            v_dtype=jnp.dtype(state[1].dtype), carry=self.carry_on,
+            carry_dtype=(
+                jnp.result_type(state[2]) if self.carry_on else None
+            ),
+            has_field=self.has_field,
+        )
+        return runner, self._field_args()
+
+    def chunk(self, state, start: int, length: int):
+        """March layers start+1..start+length through the cached chunk
+        program; returns (state', abs_chunk, rel_chunk, solve_s,
+        compile_s)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        if length not in self._jit:
+            self._jit[length] = self._build_runner(length, state)
+        runner, extra = self._jit[length]
+        uneven = self.kind == "uneven"
+        if uneven:
+            state = self._to_padded(state)
+        args = tuple(state[: 2 if not self.compensated else 3])
+        args = args + (jnp.int32(start),) + extra
+        compile_s = 0.0
+        if length not in self._compiled:
+            t0 = time.perf_counter()
+            self._compiled[length] = runner.lower(*args).compile()
+            compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = self._compiled[length](*args)
+        jax.block_until_ready(out)
+        if self.compensated and self.kind == "sharded1":
+            u_cur, abs_c, rel_c, v, kc = out[1], out[2], out[3], out[4], \
+                out[5]
+            state = (u_cur, v, kc)
+        elif self.compensated:
+            state = (out[0], out[1], out[2])
+            abs_c, rel_c = out[3], out[4]
+        else:
+            state = (out[0], out[1])
+            abs_c, rel_c = out[2], out[3]
+        # Host readback of the small per-layer error vectors doubles as
+        # the execution proof (leapfrog._timed_compile_run rationale).
+        abs_np = np.asarray(abs_c, dtype=np.float64)
+        rel_np = np.asarray(rel_c, dtype=np.float64)
+        solve_s = time.perf_counter() - t0
+        if uneven:
+            state = self._from_padded(state)
+        return state, abs_np, rel_np, solve_s, compile_s
+
+    def _to_padded(self, state):
+        """Topology-layout -> padded (MX*D, N, N) layout for the uneven
+        pad-and-mask chunk program (the same re-placement
+        resume_sharded_kfused performs per call)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        dg, _ = self._uneven_layout()
+        padw = ((0, dg - self.problem.N), (0, 0), (0, 0))
+        sharding = NamedSharding(self.mesh, P("x"))
+        return tuple(
+            jax.device_put(
+                jnp.pad(jnp.asarray(a, self.dtype)[: self.problem.N],
+                        padw),
+                sharding,
+            )
+            for a in state
+        )
+
+    def _from_padded(self, state):
+        from wavetpu.solver.sharded_kfused import _to_topology_layout
+
+        return tuple(
+            _to_topology_layout(a, self.problem, self.mesh, self.grid[0])
+            for a in state
+        )
+
+    # -- state <-> checkpoints -----------------------------------------
+
+    def health_arrays(self, state):
+        return tuple(a for a in state if a is not None)
+
+    def _shim_result(self, state, step: int):
+        from wavetpu.solver.leapfrog import SolveResult
+
+        import numpy as np
+
+        if self.compensated:
+            u, v, c = state
+            u_prev = (
+                u.astype(self.f) - v.astype(self.f)
+            ).astype(u.dtype)
+            u_cur, comp_v, comp_carry = u, v, c
+        else:
+            u_prev, u_cur = state
+            comp_v = comp_carry = None
+        z = np.zeros((0,))
+        return SolveResult(
+            problem=self.problem, u_prev=u_prev, u_cur=u_cur,
+            abs_errors=z, rel_errors=z, final_step=step,
+            comp_v=comp_v, comp_carry=comp_carry,
+        )
+
+    def save(self, rot: CheckpointRotation, state, step: int) -> str:
+        from wavetpu.io import checkpoint
+
+        res = self._shim_result(state, step)
+        if self.saves_directory:
+            return rot.save(
+                lambda p: checkpoint.save_sharded_checkpoint(p, res),
+                step, directory=True,
+            )
+        return rot.save(
+            lambda p: checkpoint.save_checkpoint(p, res), step,
+            directory=False,
+        )
+
+    def load(self, path: str):
+        """Reload a rotation entry -> (prepared state, step)."""
+        from wavetpu.io import checkpoint
+
+        if os.path.isdir(path):
+            _, u_prev, u_cur, step, _, scheme, aux = (
+                checkpoint.load_sharded_checkpoint(path)
+            )
+            if self.compensated:
+                v, c = aux
+                state = (u_cur, v, c if self.carry_on else None)
+            else:
+                state = (u_prev, u_cur)
+        else:
+            _, u_prev, u_cur, step = checkpoint.load_checkpoint(path)
+            if self.compensated:
+                v, c = checkpoint.load_checkpoint_aux(path)
+                state = (u_cur, v, c if self.carry_on else None)
+            else:
+                state = (u_prev, u_cur)
+        return self.prepare(state), step
+
+    def prepare(self, state):
+        """Device placement + dtype normalization for an injected state
+        (a loaded checkpoint) - mirrors the resume_* entry points."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        def place(a, dt=None):
+            a = jnp.asarray(a) if dt is None else jnp.asarray(a, dt)
+            if self.kind == "sharded1":
+                from wavetpu.core.grid import AXIS_NAMES
+
+                return jax.device_put(
+                    a, NamedSharding(self.mesh, P(*AXIS_NAMES))
+                )
+            if self.kind in ("sharded_kfused", "sharded_kfused_comp"):
+                return jax.device_put(
+                    a, NamedSharding(self.mesh, P("x", "y"))
+                )
+            if self.kind == "uneven":
+                from wavetpu.core.grid import AXIS_NAMES
+
+                return jax.device_put(
+                    a, NamedSharding(self.mesh, P(*AXIS_NAMES))
+                )
+            return a
+
+        if self.compensated:
+            from wavetpu.solver.kfused_comp import _normalize_carry
+
+            u, v, c = state
+            if c is not None:
+                if self.k > 1:
+                    # Preserve a valid stored carry dtype (bf16 carries
+                    # resume bitwise) - resume_kfused_comp's rule.
+                    c = place(_normalize_carry(jnp.asarray(c),
+                                               self.dtype))
+                else:
+                    # The 1-step compensated scans carry the state dtype
+                    # (resume_compensated's unconditional cast).
+                    c = place(c, self.dtype)
+            v = place(v) if self.k > 1 else place(v, self.dtype)
+            return (place(u, self.dtype), v, c)
+        u_prev, u_cur = state
+        return (place(u_prev, self.dtype), place(u_cur, self.dtype))
+
+    def to_result(self, state, abs_full, rel_full, final_step: int,
+                  init_s: float, solve_s: float, marched: int):
+        from wavetpu.solver.leapfrog import SolveResult
+
+        import jax.numpy as jnp
+
+        if state is None:
+            # Watchdog trip before any checkpoint existed: there is no
+            # good state to report; a zero field marks "nothing survived"
+            # without smuggling garbage into downstream consumers.
+            z = jnp.zeros((self.problem.N,) * 3, self.dtype)
+            state = (z, z, z) if self.compensated else (z, z)
+        shim = self._shim_result(state, final_step)
+        return SolveResult(
+            problem=self.problem,
+            u_prev=shim.u_prev,
+            u_cur=shim.u_cur,
+            abs_errors=abs_full,
+            rel_errors=rel_full,
+            init_seconds=init_s,
+            solve_seconds=solve_s,
+            steps_computed=max(marched, 0) or None,
+            final_step=final_step,
+            comp_v=shim.comp_v,
+            comp_carry=shim.comp_carry,
+        )
+
+
+# ------------------------------------------------------------ supervise
+
+
+def chunk_length(ckpt_every: int, fuse_steps: int) -> int:
+    """The supervised chunk length: `ckpt_every` snapped DOWN to a
+    multiple of the k-fusion block (min one block), so every chunk
+    boundary lands on the uninterrupted march's block grid and the
+    supervised trajectory stays bitwise-identical."""
+    if ckpt_every < 1:
+        raise ValueError(f"ckpt_every must be >= 1, got {ckpt_every}")
+    k = max(1, fuse_steps)
+    return max(k, (ckpt_every // k) * k)
+
+
+def supervise(problem, spec: PathSpec, opts: SupervisorOptions,
+              state=None, start_step: Optional[int] = None
+              ) -> SupervisedResult:
+    """Run (or resume) a solve under supervision; see module docstring.
+
+    `state`/`start_step` inject a loaded checkpoint (the CLI's --resume):
+    the supervisor re-enters through the cached chunk programs and keeps
+    checkpointing on its own boundary grid.  Without them the march
+    starts from scratch via the ordinary solve program.
+    """
+    import jax
+    import numpy as np
+
+    from wavetpu.run import faults, health
+
+    path = _Path(problem, spec)
+    is_main = jax.process_index() == 0
+    rot = CheckpointRotation(opts.ckpt_dir, keep=opts.keep,
+                             is_main=is_main)
+    T = problem.timesteps
+    L = chunk_length(opts.ckpt_every, path.k)
+    hook = opts.chunk_hook or faults.hook_from_env()
+    abs_full = np.zeros((T + 1,), dtype=np.float64)
+    rel_full = np.zeros((T + 1,), dtype=np.float64)
+    init_s = solve_s = overhead_s = 0.0
+    ckpts = 0
+    retries_used = 0
+    marched = 0
+    amax = None
+    status = "complete"
+    cur: Optional[int] = None
+
+    if state is not None:
+        if start_step is None:
+            raise ValueError("state injection requires start_step")
+        state = path.prepare(state)
+        cur = start_step
+        if rot.latest_path() is None:
+            # Seed a fresh rotation with the injected state: the retry
+            # and watchdog-halt fallbacks reload `latest`, and without
+            # this seed a resumed run whose first chunk trips would
+            # restart from layer 0 (or halt reporting step 0) even
+            # though the caller's checkpoint was perfectly good.
+            t0 = time.perf_counter()
+            path.save(rot, state, cur)
+            ckpts += 1
+            overhead_s += time.perf_counter() - t0
+
+    with _SignalGuard(opts.handle_signals) as sig:
+        while True:
+            if state is None:
+                b = min(T, 1 + L)
+                state, a, r, i_s, s_s = path.first(b)
+                abs_full[: b + 1] = a
+                rel_full[: b + 1] = r
+                init_s += i_s
+                solve_s += s_s
+                marched += b
+                cur = b
+            elif cur < T:
+                length = min(L, T - cur)
+                state, a, r, s_s, c_s = path.chunk(state, cur, length)
+                abs_full[cur + 1: cur + length + 1] = a
+                rel_full[cur + 1: cur + length + 1] = r
+                init_s += c_s
+                solve_s += s_s
+                marched += length
+                cur += length
+            # ---- chunk-boundary bookkeeping at layer `cur` ----
+            if hook is not None:
+                state = hook(state, cur)
+            t0 = time.perf_counter()
+            ok = True
+            if opts.watchdog:
+                amax = health.state_amax(path.health_arrays(state))
+                ok = health.healthy(amax, opts.max_amp)
+            if not ok:
+                latest = rot.latest_path()
+                if retries_used < opts.retries:
+                    # Transient-fault model: reload the last-good
+                    # checkpoint (or restart from scratch if none yet)
+                    # and re-run the tripped chunk.
+                    retries_used += 1
+                    if latest is None:
+                        state, cur = None, None
+                    else:
+                        state, cur = path.load(latest)
+                    overhead_s += time.perf_counter() - t0
+                    continue
+                status = "watchdog"
+                if latest is not None:
+                    state, cur = path.load(latest)
+                else:
+                    state, cur = None, 0
+                abs_full[cur + 1:] = 0.0
+                rel_full[cur + 1:] = 0.0
+                overhead_s += time.perf_counter() - t0
+                break
+            path.save(rot, state, cur)
+            ckpts += 1
+            overhead_s += time.perf_counter() - t0
+            if cur >= T:
+                break
+            if sig.triggered is not None:
+                status = "preempted"
+                abs_full[cur + 1:] = 0.0
+                rel_full[cur + 1:] = 0.0
+                break
+
+    result = path.to_result(
+        state, abs_full, rel_full, cur or 0, init_s, solve_s, marched
+    )
+    exit_code = {
+        "complete": EXIT_COMPLETE,
+        "preempted": EXIT_PREEMPTED,
+        "watchdog": EXIT_WATCHDOG,
+    }[status]
+    return SupervisedResult(
+        result=result,
+        status=status,
+        exit_code=exit_code,
+        final_step=cur or 0,
+        checkpoint_path=rot.latest_path(),
+        checkpoints_written=ckpts,
+        retries_used=retries_used,
+        overhead_seconds=overhead_s,
+        amax_last=amax,
+    )

@@ -118,16 +118,21 @@ def _validate(problem: Problem, k: int, c2tau2_field=None,
 
 
 def _make_march(problem, dtype, k, compute_errors, block_x, interpret,
-                nsteps, c2tau2_field=None):
+                nsteps, c2tau2_field=None, chunk_len=None):
     """Shared march: k-fused blocks + a 1-step remainder tail.
 
-    Both `make_kfused_solver` and `resume_kfused` MUST use this single
-    implementation - the bitwise-equal-resume guarantee rests on every
-    path emitting the identical per-layer op sequence (the same reasoning
-    as leapfrog._scan_layers being shared).
+    `make_kfused_solver`, `resume_kfused`, and `make_chunk_runner` MUST
+    use this single implementation - the bitwise-equal-resume guarantee
+    rests on every path emitting the identical per-layer op sequence (the
+    same reasoning as leapfrog._scan_layers being shared).
 
     Returns `march(u_prev, u_cur, start)` -> (u_prev, u_cur, abs, rel)
-    covering layers start+1..nsteps (`start` must be a Python int).
+    covering layers start+1..nsteps (`start` must be a Python int).  With
+    `chunk_len` set, the march instead covers exactly chunk_len layers
+    from a RUNTIME `start` (nblocks/remainder derive from chunk_len, so
+    one compiled program serves every equal-length chunk of a supervised
+    march); on block-aligned starts the op sequence equals the
+    uninterrupted march's prefix.
 
     With `c2tau2_field` every k-block runs the variable-c onion and the
     bootstrap/remainder run the 1-step variable-c pallas kernel - the
@@ -167,8 +172,12 @@ def _make_march(problem, dtype, k, compute_errors, block_x, interpret,
         return (up, uc), (abs_e, rel_e)
 
     def march(u_prev, u_cur, start, *field_params):
-        nblocks = (nsteps - start) // k
-        rem = (nsteps - start) - nblocks * k
+        if chunk_len is None:
+            nblocks = (nsteps - start) // k
+            rem = (nsteps - start) - nblocks * k
+        else:
+            nblocks = chunk_len // k
+            rem = chunk_len - nblocks * k
         starts = start + k * jnp.arange(nblocks)
         (u_prev, u_cur), (abs_b, rel_b) = lax.scan(
             lambda carry, nstart: kblock(carry, nstart, field_params),
@@ -178,9 +187,14 @@ def _make_march(problem, dtype, k, compute_errors, block_x, interpret,
         rel_parts = [rel_b.reshape(-1)]
         if rem:
             params = field_params[0] if has_field else params0
-            (u_prev, u_cur), (ra, rr) = leapfrog._scan_layers(
+            rem_start = (
+                nsteps - rem if chunk_len is None
+                else start + chunk_len - rem
+            )
+            (u_prev, u_cur), (ra, rr) = leapfrog._scan_layers_xs(
                 problem, step1_fn, params, errors, compute_errors, dtype,
-                u_prev, u_cur, nsteps - rem, nsteps,
+                u_prev, u_cur,
+                rem_start + 1 + jnp.arange(rem, dtype=jnp.int32),
             )
             abs_parts.append(ra)
             rel_parts.append(rr)
@@ -358,3 +372,44 @@ def resume_kfused(
         steps_computed=nsteps - start_step,
         final_step=nsteps,
     )
+
+
+def make_chunk_runner(
+    problem: Problem,
+    dtype=jnp.float32,
+    length: int = 4,
+    k: int = 4,
+    compute_errors: bool = True,
+    block_x: Optional[int] = None,
+    interpret: bool = False,
+    c2tau2_field=None,
+):
+    """Fixed-length k-fused re-entry for supervised solves.
+
+    Returns `(runner, run_params)`; `runner(u_prev, u_cur, start,
+    *run_params)` marches layers start+1..start+length with a RUNTIME
+    `start` - one compiled program per chunk length, reused across every
+    chunk (run/supervisor.py's no-retrace contract).  Chunks whose length
+    is a multiple of k on starts aligned to the uninterrupted march's
+    block grid reproduce its op sequence exactly; a trailing length % k
+    runs the 1-step kernel, as the uninterrupted remainder tail does.
+    """
+    _validate(problem, k, c2tau2_field, compute_errors)
+    if length < 1:
+        raise ValueError(f"chunk length must be >= 1, got {length}")
+    f = stencil_ref.compute_dtype(dtype)
+    field_dev = None
+    if c2tau2_field is not None:
+        field_dev = leapfrog.ParamStep.materialize(
+            jnp.asarray(c2tau2_field, dtype=f)
+        )
+    march, _, _ = _make_march(
+        problem, dtype, k, compute_errors, block_x, interpret, None,
+        field_dev, chunk_len=length,
+    )
+
+    def run(u_prev, u_cur, start, *field_params):
+        return march(u_prev, u_cur, start, *field_params)
+
+    run_params = () if field_dev is None else (field_dev,)
+    return jax.jit(run), run_params

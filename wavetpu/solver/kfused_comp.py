@@ -162,15 +162,19 @@ def _error_fn_guarded(problem: Problem, dtype):
 
 
 def _make_march(problem, dtype, v_dtype, carry_on, k, compute_errors,
-                block_x, interpret, nsteps, has_field=False):
+                block_x, interpret, nsteps, has_field=False,
+                chunk_len=None):
     """Shared march: k-fused blocks + a k=1 tail through the SAME kernel.
 
     Returns `march(u, v, carry, start, *field_params)` ->
     (u, v, carry, abs, rel) covering layers start+1..nsteps (`start` a
     Python int).  Shared by solve and resume so a resumed run's op
-    sequence equals the uninterrupted run's.  With `has_field` the
-    c^2tau^2 field rides `field_params[0]` as a runtime argument
-    (leapfrog.ParamStep reasoning) into every onion call.
+    sequence equals the uninterrupted run's.  With `chunk_len` set the
+    march covers exactly chunk_len layers from a RUNTIME `start`
+    (run/supervisor.py's cached chunk program); on block-aligned starts
+    the op sequence equals the uninterrupted march's prefix.  With
+    `has_field` the c^2tau^2 field rides `field_params[0]` as a runtime
+    argument (leapfrog.ParamStep reasoning) into every onion call.
     """
     f = stencil_ref.compute_dtype(dtype)
     sx, ct, syz, rsyz, xmask, inv_absx = kfused._oracle_parts(problem, f)
@@ -209,8 +213,12 @@ def _make_march(problem, dtype, v_dtype, carry_on, k, compute_errors,
 
     def march(u, v, carry, start, *field_params):
         field = field_params[0] if has_field else None
-        nblocks = (nsteps - start) // k
-        rem = (nsteps - start) - nblocks * k
+        if chunk_len is None:
+            nblocks = (nsteps - start) // k
+            rem = (nsteps - start) - nblocks * k
+        else:
+            nblocks = chunk_len // k
+            rem = chunk_len - nblocks * k
 
         def body(state, nstart):
             u, v, carry = state
@@ -226,8 +234,12 @@ def _make_march(problem, dtype, v_dtype, carry_on, k, compute_errors,
         abs_parts = [abs_b.reshape(-1)]
         rel_parts = [rel_b.reshape(-1)]
         for t in range(rem):
+            rem_start = (
+                nsteps - rem if chunk_len is None
+                else start + chunk_len - rem
+            )
             u, v, carry, abs_1, rel_1 = kblock(
-                u, v, carry, nsteps - rem + t, 1, None, field
+                u, v, carry, rem_start + t, 1, None, field
             )
             abs_parts.append(abs_1)
             rel_parts.append(rel_1)
@@ -416,7 +428,8 @@ def _validate_sharded(problem: Problem, dtype, v_dtype, carry, k, n_x,
 
 def _make_sharded_runner(problem, mesh, grid, dtype, v_dtype, carry_on, k,
                          compute_errors, nsteps, start_step, block_x,
-                         interpret, carry_dtype=None, has_field=False):
+                         interpret, carry_dtype=None, has_field=False,
+                         chunk_len=None):
     """Sharded velocity-form runner over (MX, MY, 1): the distributed
     flagship.
 
@@ -435,6 +448,11 @@ def _make_sharded_runner(problem, mesh, grid, dtype, v_dtype, carry_on, k,
     runtime argument; it is time-invariant, so its y extension and
     x-ghost exchange happen ONCE per solve per needed ghost depth
     (k-blocks; k=1 for bootstrap/remainder), outside the layer scan.
+
+    With `chunk_len` set (start_step must be None) the runner is the
+    supervised chunk program `run(u, v, carry, start, ...)`: exactly
+    chunk_len layers from a RUNTIME start, one compiled program reused
+    across every chunk (run/supervisor.py).
     """
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
@@ -453,9 +471,13 @@ def _make_sharded_runner(problem, mesh, grid, dtype, v_dtype, carry_on, k,
     perm_bwd = [(i, (i - 1) % n_x) for i in range(n_x)]
     perm_fwd_y = [(i, (i + 1) % n_y) for i in range(n_y)]
     perm_bwd_y = [(i, (i - 1) % n_y) for i in range(n_y)]
-    start = 1 if start_step is None else start_step
-    nblocks = (nsteps - start) // k
-    rem = (nsteps - start) - nblocks * k
+    if chunk_len is None:
+        start = 1 if start_step is None else start_step
+        nblocks = (nsteps - start) // k
+        rem = (nsteps - start) - nblocks * k
+    else:
+        nblocks = chunk_len // k
+        rem = chunk_len - nblocks * k
     # One block_x for every kk so the op sequence matches the
     # single-device kernel's block partitioning (bitwise contract).
     itemsizes = (
@@ -556,8 +578,13 @@ def _make_sharded_runner(problem, mesh, grid, dtype, v_dtype, carry_on, k,
         rows_d.append(dmb.reshape(-1, nl))
         rows_r.append(rmb.reshape(-1, nl))
         for t in range(rem):
-            layer = nsteps - rem + 1 + t
-            sxct_1 = lax.dynamic_slice(sxct_loc, (layer, 0), (1, nl))
+            # == nsteps - rem + 1 + t on the full march; phrasing it off
+            # `first` keeps the identical arithmetic valid for a traced
+            # chunk start.
+            layer = jnp.asarray(first + nblocks * k + 1 + t, jnp.int32)
+            sxct_1 = lax.dynamic_slice(
+                sxct_loc, (layer, jnp.int32(0)), (1, nl)
+            )
             u, v, c, dm, rm = kcall(
                 syz_c, rsyz_c, u, v, c, sxct_1, 1, problem.a2tau2,
                 compute_errors, fp_1,
@@ -581,6 +608,42 @@ def _make_sharded_runner(problem, mesh, grid, dtype, v_dtype, carry_on, k,
     plane_spec = P("y", None)
 
     field_specs = (state_spec,) if has_field else ()
+
+    if chunk_len is not None:
+        assert start_step is None
+
+        def local_chunk(u, v, c, start, sxct_loc, syz_c, rsyz_c, *fargs):
+            return local_march(
+                syz_c, rsyz_c, u, v, c, sxct_loc, start,
+                fargs[0] if has_field else None,
+            )
+
+        local_fn = compat.shard_map(
+            local_chunk, mesh=mesh,
+            in_specs=(state_spec, state_spec,
+                      state_spec if carry_on else None,
+                      P(), rows_spec, plane_spec, plane_spec)
+            + field_specs,
+            out_specs=(state_spec, state_spec,
+                       state_spec if carry_on else None,
+                       rows_spec, rows_spec),
+            check_vma=False,
+        )
+
+        def run_chunk(u, v, c, start, *fargs):
+            u, v, c, dmax, rmax = local_fn(
+                u, v, c, start, sxct_all, syz, rsyz, *fargs
+            )
+            if compute_errors:
+                ctk = lax.dynamic_slice(ct, (start + 1,), (chunk_len,))
+                abs_e, rel_e = kfused._block_errors(
+                    dmax, rmax, ctk, xmask, inv_absx
+                )
+            else:
+                abs_e = rel_e = jnp.zeros((chunk_len,), f)
+            return u, v, c, abs_e, rel_e
+
+        return jax.jit(run_chunk)
 
     if start_step is None:
 
@@ -874,4 +937,82 @@ def resume_kfused_comp(
     )
     return _as_result(
         problem, out, init_s, solve_s, nsteps - start_step, nsteps
+    )
+
+
+def make_chunk_runner(
+    problem: Problem,
+    dtype=jnp.float32,
+    length: int = 4,
+    k: int = 4,
+    compute_errors: bool = True,
+    block_x: Optional[int] = None,
+    interpret: bool = False,
+    v_dtype=None,
+    carry: bool = True,
+    c2tau2_field=None,
+):
+    """Fixed-length compensated k-fused re-entry for supervised solves.
+
+    Returns `(runner, run_params)`; `runner(u, v, carry, start,
+    *run_params)` (carry=None resumes the carry-less increment form)
+    marches layers start+1..start+length with a RUNTIME `start` - one
+    compiled program per chunk length (run/supervisor.py).  On
+    block-aligned starts with length a multiple of k the op sequence
+    equals the uninterrupted march's prefix, so supervision preserves
+    the velocity-form onion's exact trajectory.
+    """
+    v_dtype = dtype if v_dtype is None else jnp.dtype(v_dtype)
+    _validate(problem, dtype, v_dtype, carry, k, c2tau2_field,
+              compute_errors)
+    if length < 1:
+        raise ValueError(f"chunk length must be >= 1, got {length}")
+    f = stencil_ref.compute_dtype(dtype)
+    has_field = c2tau2_field is not None
+    march = _make_march(
+        problem, dtype, v_dtype, carry, k, compute_errors, block_x,
+        interpret, None, has_field, chunk_len=length,
+    )
+
+    def run(u_cur, v, carry, start, *field_params):
+        return march(u_cur, v, carry, start, *field_params)
+
+    run_params = ()
+    if has_field:
+        run_params = (leapfrog.ParamStep.materialize(
+            jnp.asarray(c2tau2_field, dtype=f)
+        ),)
+    return jax.jit(run), run_params
+
+
+def make_sharded_chunk_runner(
+    problem: Problem,
+    mesh,
+    grid,
+    dtype=jnp.float32,
+    length: int = 4,
+    k: int = 4,
+    compute_errors: bool = True,
+    block_x: Optional[int] = None,
+    interpret: Optional[bool] = None,
+    v_dtype=None,
+    carry: bool = True,
+    carry_dtype=None,
+    has_field: bool = False,
+):
+    """Sharded counterpart of `make_chunk_runner` over an (MX, MY, 1)
+    mesh: `runner(u, v, carry, start[, field])` with all state P("x","y")
+    on `mesh` and a RUNTIME `start` - the supervised chunk program for
+    the distributed velocity-form flagship."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    v_dtype = dtype if v_dtype is None else jnp.dtype(v_dtype)
+    _validate_sharded(problem, dtype, v_dtype, carry, k, grid[0], grid[1],
+                      None, True)
+    if length < 1:
+        raise ValueError(f"chunk length must be >= 1, got {length}")
+    return _make_sharded_runner(
+        problem, mesh, grid, dtype, v_dtype, carry, k, compute_errors,
+        None, None, block_x, interpret, carry_dtype=carry_dtype,
+        has_field=has_field, chunk_len=length,
     )

@@ -151,7 +151,7 @@ def initial_state(problem: Problem, dtype=jnp.float32) -> Tuple[jax.Array, jax.A
     return u0, u1.astype(dtype)
 
 
-def _scan_layers(
+def _scan_layers_xs(
     problem: Problem,
     step: Callable,
     step_params,
@@ -160,15 +160,17 @@ def _scan_layers(
     dtype,
     u_prev,
     u_cur,
-    start: int,
-    stop: int,
+    xs,
 ):
-    """March layers start+1..stop from carry (layer start-1, layer start).
+    """March one layer per element of `xs` (the layer indices, which may be
+    traced - the supervisor's chunk runners pass `start + 1 + arange(L)`
+    with a runtime `start` so one compiled program serves every chunk).
 
-    The single scan body shared by `make_solver` and `resume` - keeping it
-    shared is what makes a resumed run's op sequence identical to the
-    uninterrupted run's (the bitwise-equality invariant of
-    tests/test_checkpoint.py).
+    The single scan body shared by `make_solver`, `resume`, and
+    `make_chunk_runner` - keeping it shared is what makes a resumed or
+    supervised run's op sequence identical to the uninterrupted run's (the
+    bitwise-equality invariant of tests/test_checkpoint.py and
+    tests/test_supervisor.py).
     """
 
     err_dtype = stencil_ref.compute_dtype(dtype)
@@ -182,7 +184,26 @@ def _scan_layers(
             ae = re = jnp.zeros((), err_dtype)
         return (u, u_next), (ae, re)
 
-    return jax.lax.scan(body, (u_prev, u_cur), jnp.arange(start + 1, stop + 1))
+    return jax.lax.scan(body, (u_prev, u_cur), xs)
+
+
+def _scan_layers(
+    problem: Problem,
+    step: Callable,
+    step_params,
+    errors: Callable,
+    compute_errors: bool,
+    dtype,
+    u_prev,
+    u_cur,
+    start: int,
+    stop: int,
+):
+    """March layers start+1..stop from carry (layer start-1, layer start)."""
+    return _scan_layers_xs(
+        problem, step, step_params, errors, compute_errors, dtype,
+        u_prev, u_cur, jnp.arange(start + 1, stop + 1),
+    )
 
 
 def _timed_compile_run(runner, example_args=(), sync=None):
@@ -534,6 +555,80 @@ def resume(
         steps_computed=nsteps - start_step,
         final_step=nsteps,
     )
+
+
+def make_chunk_runner(
+    problem: Problem,
+    dtype=jnp.float32,
+    length: int = 1,
+    step_fn: Optional[Callable] = None,
+    compute_errors: bool = True,
+):
+    """Fixed-length re-entry program for supervised solves (run/supervisor).
+
+    Returns `(runner, step_params)`; `runner(u_prev, u_cur, start,
+    step_params)` marches layers start+1..start+length with `start` a
+    RUNTIME scalar, so one compiled program serves every equal-length
+    chunk of a supervised march - no per-chunk retracing.  The scan body
+    is `_scan_layers_xs`, the same one `solve`/`resume` run, so chunked
+    layers are bitwise-identical to an uninterrupted march's.  Error
+    outputs cover exactly the chunk's layers (the supervisor assembles
+    the full per-layer vectors on host).
+    """
+    if length < 1:
+        raise ValueError(f"chunk length must be >= 1, got {length}")
+    step, step_params = _as_param_step(step_fn)
+    errors = _error_fn(problem, dtype)
+
+    def run(u_prev, u_cur, start, step_params):
+        xs = start + 1 + jnp.arange(length, dtype=jnp.int32)
+        (u_p, u_c), (abs_t, rel_t) = _scan_layers_xs(
+            problem, step, step_params, errors, compute_errors, dtype,
+            u_prev, u_cur, xs,
+        )
+        return u_p, u_c, abs_t, rel_t
+
+    return jax.jit(run), step_params
+
+
+def make_comp_chunk_runner(
+    problem: Problem,
+    dtype=jnp.float32,
+    length: int = 1,
+    comp_step_fn: Optional[Callable] = None,
+    compute_errors: bool = True,
+):
+    """Compensated-scheme counterpart of `make_chunk_runner`:
+    `runner(u, v, carry, start)` marches `length` layers from the
+    compensated state with a runtime `start` - the same scan body as
+    `resume_compensated`, compiled once per chunk length."""
+    if length < 1:
+        raise ValueError(f"chunk length must be >= 1, got {length}")
+    if dtype == jnp.bfloat16:
+        raise ValueError("compensated scheme requires f32/f64 state")
+    step = (
+        comp_step_fn if comp_step_fn is not None
+        else stencil_ref.compensated_step
+    )
+    errors = _error_fn(problem, dtype)
+
+    def run(u_cur, v, carry, start):
+        def body(state, layer):
+            u, vv, cc = state
+            u2, v2, c2 = step(u, vv, cc, problem, None)
+            if compute_errors:
+                ae, re = errors(u2, layer)
+            else:
+                ae = re = jnp.zeros((), dtype)
+            return (u2, v2, c2), (ae, re)
+
+        xs = start + 1 + jnp.arange(length, dtype=jnp.int32)
+        (u, vv, cc), (abs_t, rel_t) = jax.lax.scan(
+            body, (u_cur, v, carry), xs
+        )
+        return u, vv, cc, abs_t, rel_t
+
+    return jax.jit(run)
 
 
 def solve_history(problem: Problem, dtype=jnp.float32) -> np.ndarray:

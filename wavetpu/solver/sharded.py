@@ -426,7 +426,11 @@ def _local_solve_fns(
         u1 = (0.5 * (u0.astype(f) + s.astype(f))).astype(dtype)
         return bc, (u0, u1), u1
 
-    def scan_layers(step_args, carry0, start, stop, errors):
+    def scan_layers(step_args, carry0, xs, errors):
+        # `xs` holds the layer indices to march - `arange(start+1, stop+1)`
+        # for solve/resume, `start + 1 + arange(L)` with a RUNTIME start for
+        # the supervisor's cached chunk program.  One body serves all three,
+        # which is what keeps resumed/supervised layers bitwise-identical.
         bc, field = step_args
 
         if compensated:
@@ -442,7 +446,7 @@ def _local_solve_fns(
                 ae, re = errors(u_next, layer)
                 return (u, u_next), (ae, re)
 
-        return lax.scan(body, carry0, jnp.arange(start + 1, stop + 1))
+        return lax.scan(body, carry0, xs)
 
     def final_state(carry):
         """(u_prev, u_cur) from the scan carry; the compensated carry
@@ -510,7 +514,7 @@ def make_sharded_solver(
         a0 = r0 = jnp.zeros((), f)  # layer 0 assigned from the oracle
         a1, r1 = errors(u1, 1)
         carry, (abs_t, rel_t) = scan_layers(
-            (bc, field), carry0, 1, nsteps, errors
+            (bc, field), carry0, jnp.arange(2, nsteps + 1), errors
         )
         u_prev, u_cur = final_state(carry)
         abs_all = jnp.concatenate([jnp.stack([a0, a1]), abs_t])
@@ -592,7 +596,8 @@ def make_sharded_resumer(
         errors = errors_fn(mex, mey, mez, sx, sy, sz, ct)
         bc = bcx[:, None, None] * bcy[None, :, None] * bcz[None, None, :]
         carry, (abs_t, rel_t) = scan_layers(
-            (bc, field), state, start_step, nsteps, errors
+            (bc, field), state, jnp.arange(start_step + 1, nsteps + 1),
+            errors,
         )
         u_p, u_c = final_state(carry)
         head = jnp.zeros((start_step + 1,), f)
@@ -629,6 +634,90 @@ def make_sharded_resumer(
         )
         rt_args = state_and_args[n_state:]
         return sharded_fn(*state, sx, sy, sz, *bcs, *mes, ct, *rt_args)
+
+    return jax.jit(run)
+
+
+def make_sharded_chunk_runner(
+    problem: Problem,
+    topo: Topology,
+    mesh: jax.sharding.Mesh,
+    length: int,
+    dtype=jnp.float32,
+    compute_errors: bool = True,
+    kernel: str = "roll",
+    overlap: bool = False,
+    interpret: bool = False,
+    has_field: bool = False,
+    scheme: str = "standard",
+):
+    """Fixed-length sharded re-entry for supervised solves.
+
+    `runner(u_prev, u_cur, start[, field])` (compensated: `runner(u, v,
+    carry, start[, field])`) marches layers start+1..start+length with a
+    RUNTIME `start` - one compiled program per chunk length, reused for
+    every chunk (run/supervisor.py).  The scan body is the same
+    `scan_layers` closure `make_sharded_solver`/`make_sharded_resumer`
+    run, so supervised layers stay bitwise-identical to an uninterrupted
+    sharded solve's.
+    """
+    if length < 1:
+        raise ValueError(f"chunk length must be >= 1, got {length}")
+    f = stencil_ref.compute_dtype(dtype)
+    (sx, sy, sz), bcs, mes, ct = _replicated_inputs(problem, topo, dtype)
+    errors_fn, _, scan_layers, final_state = _local_solve_fns(
+        problem, topo, dtype, compute_errors, kernel, overlap, interpret,
+        scheme,
+    )
+    compensated = scheme == "compensated"
+    n_state = 3 if compensated else 2
+
+    def local_chunk(*args):
+        state = args[:n_state]
+        (start, sx, sy, sz, bcx, bcy, bcz, mex, mey, mez, ct, *rest) = (
+            args[n_state:]
+        )
+        field = rest[0] if has_field else None
+        errors = errors_fn(mex, mey, mez, sx, sy, sz, ct)
+        bc = bcx[:, None, None] * bcy[None, :, None] * bcz[None, None, :]
+        xs = start + 1 + jnp.arange(length, dtype=jnp.int32)
+        carry, (abs_t, rel_t) = scan_layers((bc, field), state, xs, errors)
+        u_p, u_c = final_state(carry)
+        if compensated:
+            _, v, kc = carry
+            return u_p, u_c, abs_t, rel_t, v, kc
+        return u_p, u_c, abs_t, rel_t
+
+    state_spec = P(*AXIS_NAMES)
+    in_specs = [state_spec] * n_state + [
+        P(),
+        P("x"), P("y"), P("z"),
+        P("x"), P("y"), P("z"),
+        P("x"), P("y"), P("z"),
+        P(),
+    ]
+    if has_field:
+        in_specs.append(P(*AXIS_NAMES))
+    out_specs = [state_spec, state_spec, P(), P()]
+    if compensated:
+        out_specs += [state_spec, state_spec]
+    sharded_fn = compat.shard_map(
+        local_chunk,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=tuple(out_specs),
+        check_vma=False,
+    )
+
+    def run(*state_start_args):
+        state = tuple(
+            jnp.asarray(a, dtype) for a in state_start_args[:n_state]
+        )
+        start = state_start_args[n_state]
+        rt_args = state_start_args[n_state + 1:]
+        return sharded_fn(
+            *state, start, sx, sy, sz, *bcs, *mes, ct, *rt_args
+        )
 
     return jax.jit(run)
 

@@ -152,6 +152,7 @@ def _make_runner(
     block_x: Optional[int],
     interpret: bool,
     has_field: bool = False,
+    chunk_len: Optional[int] = None,
 ):
     """One jitted program: [bootstrap +] k-block scan + 1-step remainder.
 
@@ -162,9 +163,12 @@ def _make_runner(
     blocks, which ships the diagonal corners without extra collectives.
 
     `start_step=None` builds the from-scratch solver (bootstrap included);
-    an int builds the resume program re-entering at that layer.  Both use
-    the same local march so the per-layer op sequence is identical (the
-    bitwise-resume invariant, solver/kfused.py).
+    an int builds the resume program re-entering at that layer; with
+    `chunk_len` set (start_step None) the runner is the supervised chunk
+    program `run(u_prev, u, start, ...)` marching exactly chunk_len
+    layers from a RUNTIME start (run/supervisor.py's cached program).
+    All use the same local march so the per-layer op sequence is
+    identical (the bitwise-resume invariant, solver/kfused.py).
 
     With `has_field` the c^2tau^2 field rides as an extra P("x","y")
     runtime argument; being time-invariant, its y extension and x-ghost
@@ -176,16 +180,20 @@ def _make_runner(
     nl = problem.N // n_x
     nl_y = problem.N // n_y
     oracle_parts = kfused._oracle_parts(problem, f)
-    sx, ct, syz, rsyz, _, _ = oracle_parts
+    sx, ct, syz, rsyz, xmask, inv_absx = oracle_parts
     sxct_all = ct[:, None] * sx[None, :]            # (T+1, N)
     perm_fwd = [(i, (i + 1) % n_x) for i in range(n_x)]
     perm_bwd = [(i, (i - 1) % n_x) for i in range(n_x)]
     perm_fwd_y = [(i, (i + 1) % n_y) for i in range(n_y)]
     perm_bwd_y = [(i, (i - 1) % n_y) for i in range(n_y)]
     coeff = problem.a2tau2
-    start = 1 if start_step is None else start_step
-    nblocks = (nsteps - start) // k
-    rem = (nsteps - start) - nblocks * k
+    if chunk_len is None:
+        start = 1 if start_step is None else start_step
+        nblocks = (nsteps - start) // k
+        rem = (nsteps - start) - nblocks * k
+    else:
+        nblocks = chunk_len // k
+        rem = chunk_len - nblocks * k
 
     def ghosts(a, depth):
         """(lo, hi) ghost planes from the cyclic x-neighbours."""
@@ -267,8 +275,12 @@ def _make_runner(
         rows_d.append(dmb.reshape(-1, nl))
         rows_r.append(rmb.reshape(-1, nl))
         for t in range(rem):
-            layer = nsteps - rem + 1 + t
-            sxct_1 = lax.dynamic_slice(sxct_loc, (layer, 0), (1, nl))
+            # == nsteps - rem + 1 + t on the full march; off `first` the
+            # identical arithmetic also serves a traced chunk start.
+            layer = jnp.asarray(first + nblocks * k + 1 + t, jnp.int32)
+            sxct_1 = lax.dynamic_slice(
+                sxct_loc, (layer, jnp.int32(0)), (1, nl)
+            )
             u_prev, u, dm, rm = kcall(
                 syz_c, rsyz_c, u_prev, u, sxct_1, 1, compute_errors, None,
                 fp_1,
@@ -284,6 +296,39 @@ def _make_runner(
     plane_spec = P("y", None)
 
     field_specs = (state_spec,) if has_field else ()
+
+    if chunk_len is not None:
+        assert start_step is None
+
+        def local_chunk(u_prev, u, start, sxct_loc, syz_c, rsyz_c,
+                        *fargs):
+            return local_march(
+                syz_c, rsyz_c, u_prev, u, sxct_loc, start,
+                fargs[0] if has_field else None,
+            )
+
+        local_fn = compat.shard_map(
+            local_chunk, mesh=mesh,
+            in_specs=(state_spec, state_spec, P(), rows_spec, plane_spec,
+                      plane_spec) + field_specs,
+            out_specs=(state_spec, state_spec, rows_spec, rows_spec),
+            check_vma=False,
+        )
+
+        def run_chunk(u_prev, u, start, *fargs):
+            u_prev, u, dmax, rmax = local_fn(
+                u_prev, u, start, sxct_all, syz, rsyz, *fargs
+            )
+            if compute_errors:
+                ctk = lax.dynamic_slice(ct, (start + 1,), (chunk_len,))
+                abs_e, rel_e = kfused._block_errors(
+                    dmax, rmax, ctk, xmask, inv_absx
+                )
+            else:
+                abs_e = rel_e = jnp.zeros((chunk_len,), f)
+            return u_prev, u, abs_e, rel_e
+
+        return jax.jit(run_chunk), ()
 
     if start_step is None:
 
@@ -382,6 +427,7 @@ def _make_padded_runner(
     block_x: Optional[int],
     interpret: bool,
     has_field: bool = False,
+    chunk_len: Optional[int] = None,
 ):
     """Pad-and-mask x-only runner for uneven decompositions.
 
@@ -437,9 +483,13 @@ def _make_padded_runner(
     perm_fwd2 = [(i, (i + 2) % n_x) for i in range(n_x)]
     perm_bwd2 = [(i, (i - 2) % n_x) for i in range(n_x)]
     coeff = problem.a2tau2
-    start = 1 if start_step is None else start_step
-    nblocks = (nsteps - start) // k
-    rem = (nsteps - start) - nblocks * k
+    if chunk_len is None:
+        start = 1 if start_step is None else start_step
+        nblocks = (nsteps - start) // k
+        rem = (nsteps - start) - nblocks * k
+    else:
+        nblocks = chunk_len // k
+        rem = chunk_len - nblocks * k
     multi = n_x > 1
 
     def nm_scalar():
@@ -541,8 +591,12 @@ def _make_padded_runner(
         rows_d.append(dmb.reshape(-1, d))
         rows_r.append(rmb.reshape(-1, d))
         for t in range(rem):
-            layer = nsteps - rem + 1 + t
-            sxct_1 = lax.dynamic_slice(sxct_loc, (layer, 0), (1, d))
+            # == nsteps - rem + 1 + t on the full march (traced-start
+            # chunk form, as _make_runner).
+            layer = jnp.asarray(first + nblocks * k + 1 + t, jnp.int32)
+            sxct_1 = lax.dynamic_slice(
+                sxct_loc, (layer, jnp.int32(0)), (1, d)
+            )
             u_prev, u, dm, rm = kcall(
                 syz_c, rsyz_c, u_prev, u, sxct_1, 1, compute_errors,
                 ec2_1,
@@ -564,6 +618,39 @@ def _make_padded_runner(
         return z, z
 
     field_specs = (state_spec,) if has_field else ()
+
+    if chunk_len is not None:
+        assert start_step is None
+
+        def local_chunk(u_prev, u, start, sxct_loc, syz_c, rsyz_c,
+                        *fargs):
+            return local_march(
+                syz_c, rsyz_c, u_prev, u, sxct_loc, start,
+                fargs[0] if has_field else None,
+            )
+
+        local_fn = compat.shard_map(
+            local_chunk, mesh=mesh,
+            in_specs=(state_spec, state_spec, P(), rows_spec, plane_spec,
+                      plane_spec) + field_specs,
+            out_specs=(state_spec, state_spec, rows_spec, rows_spec),
+            check_vma=False,
+        )
+
+        def run_chunk(u_prev, u, start, *fargs):
+            u_prev, u, dmax, rmax = local_fn(
+                u_prev, u, start, sxct_all, syz, rsyz, *fargs
+            )
+            if compute_errors:
+                ctk = lax.dynamic_slice(ct, (start + 1,), (chunk_len,))
+                abs_e, rel_e = kfused._block_errors(
+                    dmax, rmax, ctk, xmask_p, inv_absx_p
+                )
+            else:
+                abs_e = rel_e = jnp.zeros((chunk_len,), f)
+            return u_prev, u, abs_e, rel_e
+
+        return jax.jit(run_chunk), (dg, pad)
 
     if start_step is None:
 
@@ -848,3 +935,45 @@ def resume_sharded_kfused(
         steps_computed=nsteps - start_step,
         final_step=nsteps,
     )
+
+
+def make_chunk_runner(
+    problem: Problem,
+    mesh,
+    grid: Tuple[int, int],
+    dtype=jnp.float32,
+    length: int = 4,
+    k: int = 4,
+    compute_errors: bool = True,
+    block_x: Optional[int] = None,
+    interpret: Optional[bool] = None,
+    has_field: bool = False,
+):
+    """Fixed-length sharded k-fused re-entry for supervised solves.
+
+    Returns `(runner, layout)` where `runner(u_prev, u_cur, start[,
+    field])` marches layers start+1..start+length with a RUNTIME `start`
+    (run/supervisor.py's cached chunk program).  On the even
+    decomposition `layout` is None and state rides P("x","y") directly;
+    on the pad-and-mask path `layout` is `(dg, pad)` and the caller
+    feeds/receives the padded (MX*D, N, N) x-sharded globals (see
+    `_make_padded_runner`; `_to_topology_layout` converts for
+    checkpointing).
+    """
+    n_x, n_y = grid
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    _validate(problem, k, n_x, n_y, None, True)
+    if length < 1:
+        raise ValueError(f"chunk length must be >= 1, got {length}")
+    if _is_even(problem, k, n_x):
+        runner, _ = _make_runner(
+            problem, mesh, grid, dtype, k, compute_errors, None, None,
+            block_x, interpret, has_field, chunk_len=length,
+        )
+        return runner, None
+    runner, layout = _make_padded_runner(
+        problem, mesh, n_x, dtype, k, compute_errors, None, None,
+        block_x, interpret, has_field, chunk_len=length,
+    )
+    return runner, layout
