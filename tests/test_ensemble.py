@@ -1,0 +1,378 @@
+"""Ensemble-core contracts (wavetpu/ensemble/batched.py).
+
+The load-bearing invariant: every lane of a batched solve is BITWISE
+identical to the same problem solved solo on the same path - including
+per-lane phases, per-lane stop layers (frozen by masking), per-lane
+c2tau2 fields, and batches padded with masked filler lanes.  A change to
+either the ensemble lane programs or the solo solvers that breaks these
+equalities is a correctness regression, not a tolerance issue.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from wavetpu.core.problem import Problem
+from wavetpu.ensemble import batched as eb
+from wavetpu.kernels import stencil_pallas, stencil_ref
+from wavetpu.solver import kfused, leapfrog
+
+
+def _bitwise(a, b):
+    return np.array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.fixture(scope="module")
+def problem():
+    return Problem(N=16, timesteps=9)
+
+
+@pytest.fixture(scope="module")
+def lanes():
+    # default phase, shifted phase, shifted phase + early stop
+    return [
+        eb.LaneSpec(),
+        eb.LaneSpec(phase=1.0),
+        eb.LaneSpec(phase=0.5, stop_step=5),
+    ]
+
+
+def _assert_lane_parity(res, solos):
+    assert res.batched, res.fallback_reason
+    assert res.fallback_reason is None
+    for got, solo in zip(res.results, solos):
+        assert _bitwise(got.u_cur, solo.u_cur)
+        assert _bitwise(got.u_prev, solo.u_prev)
+        assert got.final_step == solo.final_step
+        assert np.array_equal(got.abs_errors, solo.abs_errors)
+        assert np.array_equal(got.rel_errors, solo.rel_errors)
+
+
+class TestLaneParity:
+    def test_roll(self, problem, lanes):
+        res = eb.solve_ensemble(problem, lanes, path="roll")
+        solos = [
+            leapfrog.solve(
+                problem, phase=lane.phase, stop_step=lane.stop(problem)
+            )
+            for lane in lanes
+        ]
+        _assert_lane_parity(res, solos)
+
+    def test_pallas(self, problem, lanes):
+        res = eb.solve_ensemble(
+            problem, lanes, path="pallas", interpret=True
+        )
+        solos = [
+            leapfrog.solve(
+                problem,
+                step_fn=stencil_pallas.make_step_fn(interpret=True),
+                phase=lane.phase,
+                stop_step=lane.stop(problem),
+            )
+            for lane in lanes
+        ]
+        _assert_lane_parity(res, solos)
+
+    def test_kfused(self, problem, lanes):
+        res = eb.solve_ensemble(
+            problem, lanes, path="kfused", k=2, interpret=True
+        )
+        solos = [
+            kfused.solve_kfused(
+                problem, k=2, interpret=True, phase=lane.phase,
+                stop_step=lane.stop(problem),
+            )
+            for lane in lanes
+        ]
+        _assert_lane_parity(res, solos)
+
+    def test_kfused_remainder_tail(self, lanes):
+        # (10 - 1) % 2 == 1: the batch runs the masked 1-step tail the
+        # solo march also runs.
+        p10 = Problem(N=16, timesteps=10)
+        res = eb.solve_ensemble(
+            p10, lanes, path="kfused", k=2, interpret=True
+        )
+        solos = [
+            kfused.solve_kfused(
+                p10, k=2, interpret=True, phase=lane.phase,
+                stop_step=lane.stop(p10),
+            )
+            for lane in lanes
+        ]
+        _assert_lane_parity(res, solos)
+
+
+class TestPadding:
+    def test_padded_lanes_leave_real_lanes_bitwise_unchanged(
+        self, problem, lanes
+    ):
+        plain = eb.solve_ensemble(problem, lanes, path="roll")
+        padded = eb.solve_ensemble(problem, lanes, path="roll", pad_to=8)
+        assert padded.batch_size == 8
+        assert padded.n_lanes == 3
+        assert len(padded.results) == 3
+        for a, b in zip(padded.results, plain.results):
+            assert _bitwise(a.u_cur, b.u_cur)
+            assert _bitwise(a.u_prev, b.u_prev)
+            assert np.array_equal(a.abs_errors, b.abs_errors)
+
+    def test_padding_lane_freezes_on_every_k_grid(self):
+        lane = eb.padding_lane()
+        assert lane.stop_step == 1  # (1-1) % k == 0 for all k
+
+    def test_pad_below_batch_rejected(self, problem, lanes):
+        with pytest.raises(ValueError, match="pad_to"):
+            eb.solve_ensemble(problem, lanes, path="roll", pad_to=2)
+
+
+class TestFields:
+    @pytest.fixture(scope="class")
+    def field(self, problem):
+        return stencil_ref.make_c2tau2_field(
+            problem,
+            lambda x, y, z: problem.a2 * (
+                1.0 - 0.3 * np.exp(
+                    -((x - 0.5) ** 2 + (y - 0.5) ** 2 + (z - 0.5) ** 2)
+                    / 0.1
+                )
+            ),
+        )
+
+    def test_roll_field_parity(self, problem, field):
+        lanes = [
+            eb.LaneSpec(c2tau2_field=field),
+            eb.LaneSpec(stop_step=7),
+        ]
+        res = eb.solve_ensemble(
+            problem, lanes, path="roll", compute_errors=False
+        )
+        assert res.batched
+        solo0 = leapfrog.solve(
+            problem, step_fn=stencil_ref.make_variable_c_step(field),
+            compute_errors=False,
+        )
+        assert _bitwise(res.results[0].u_cur, solo0.u_cur)
+        # The field-less lane rides the variable-c kernel with the
+        # CONSTANT tau^2 a^2 field (fill_fields) - bitwise the solo
+        # variable-c solve with that constant field.
+        const = np.full((problem.N,) * 3, problem.a2tau2)
+        solo1 = leapfrog.solve(
+            problem, step_fn=stencil_ref.make_variable_c_step(const),
+            compute_errors=False, stop_step=7,
+        )
+        assert _bitwise(res.results[1].u_cur, solo1.u_cur)
+
+    def test_field_batch_rejects_shifted_phase(self, problem, field):
+        with pytest.raises(ValueError, match="analytic layer-1"):
+            eb.solve_ensemble(
+                problem,
+                [eb.LaneSpec(c2tau2_field=field), eb.LaneSpec(phase=0.7)],
+                path="roll", compute_errors=False,
+            )
+
+    def test_pallas_field_parity(self, problem, field):
+        res = eb.solve_ensemble(
+            problem, [eb.LaneSpec(c2tau2_field=field), eb.LaneSpec()],
+            path="pallas", compute_errors=False, interpret=True,
+        )
+        assert res.batched
+        solo = leapfrog.solve(
+            problem,
+            step_fn=stencil_pallas.make_step_fn(
+                interpret=True, c2tau2_field=field
+            ),
+            compute_errors=False,
+        )
+        assert _bitwise(res.results[0].u_cur, solo.u_cur)
+
+    def test_kfused_field_parity(self, problem, field):
+        res = eb.solve_ensemble(
+            problem, [eb.LaneSpec(c2tau2_field=field), eb.LaneSpec()],
+            path="kfused", k=2, compute_errors=False, interpret=True,
+        )
+        assert res.batched
+        solo = kfused.solve_kfused(
+            problem, k=2, interpret=True, compute_errors=False,
+            c2tau2_field=field,
+        )
+        assert _bitwise(res.results[0].u_cur, solo.u_cur)
+
+    def test_field_with_errors_rejected(self, problem, field):
+        with pytest.raises(ValueError, match="no analytic oracle"):
+            eb.solve_ensemble(
+                problem, [eb.LaneSpec(c2tau2_field=field)], path="roll",
+                compute_errors=True,
+            )
+
+    def test_field_shape_checked(self, problem):
+        with pytest.raises(ValueError, match="shape"):
+            eb.solve_ensemble(
+                problem,
+                [eb.LaneSpec(c2tau2_field=np.zeros((4, 4, 4)))],
+                path="roll", compute_errors=False,
+            )
+
+
+class TestFallbacks:
+    def test_compensated_lane_loop_recorded_and_exact(self, problem):
+        res = eb.solve_ensemble(
+            problem, [eb.LaneSpec(), eb.LaneSpec()],
+            scheme="compensated", path="pallas", interpret=True,
+        )
+        assert res.batched is False
+        assert "compensated" in res.fallback_reason
+        solo = leapfrog.solve_compensated(
+            problem,
+            comp_step_fn=stencil_pallas.make_compensated_step_fn(
+                interpret=True
+            ),
+        )
+        for r in res.results:
+            assert _bitwise(r.u_cur, solo.u_cur)
+
+    def test_compensated_kfused_lane_loop_is_the_velocity_onion(
+        self, problem
+    ):
+        # A compensated + fuse_steps request must be served by the
+        # flagship velocity-form onion, not silently downgraded to the
+        # 1-step compensated scheme.
+        from wavetpu.solver import kfused_comp
+
+        res = eb.solve_ensemble(
+            problem, [eb.LaneSpec()], scheme="compensated",
+            path="kfused", k=2, interpret=True,
+        )
+        assert res.batched is False
+        solo = kfused_comp.solve_kfused_comp(problem, k=2, interpret=True)
+        assert _bitwise(res.results[0].u_cur, solo.u_cur)
+
+    def test_probe_failure_falls_back_with_reason(
+        self, problem, lanes, monkeypatch
+    ):
+        monkeypatch.setattr(
+            eb, "vmap_capability",
+            lambda *a, **k: (False, "forced-by-test"),
+        )
+        res = eb.solve_ensemble(problem, lanes, path="roll")
+        assert res.batched is False
+        assert "forced-by-test" in res.fallback_reason
+        # The fallback still honors per-lane identity.
+        solo = leapfrog.solve(problem, phase=1.0)
+        assert _bitwise(res.results[1].u_cur, solo.u_cur)
+
+    def test_probe_verdict_is_cached(self):
+        eb._PROBE_CACHE.clear()
+        try:
+            ok1, _ = eb.vmap_capability("roll", interpret=True)
+            assert ok1
+            assert len(eb._PROBE_CACHE) == 1
+            ok2, _ = eb.vmap_capability("roll", interpret=True)
+            assert ok2 and len(eb._PROBE_CACHE) == 1
+        finally:
+            eb._PROBE_CACHE.clear()
+
+
+class TestValidation:
+    def test_empty_batch_rejected(self, problem):
+        with pytest.raises(ValueError, match="at least one lane"):
+            eb.solve_ensemble(problem, [], path="roll")
+
+    def test_bad_path_rejected(self, problem):
+        with pytest.raises(ValueError, match="path"):
+            eb.solve_ensemble(problem, [eb.LaneSpec()], path="cuda")
+
+    def test_stop_out_of_range(self, problem):
+        with pytest.raises(ValueError, match="stop_step"):
+            eb.solve_ensemble(
+                problem, [eb.LaneSpec(stop_step=99)], path="roll"
+            )
+
+    def test_kfused_misaligned_stop_rejected(self, problem):
+        # stop=4: (4-1) % 2 != 0 and 4 != timesteps -> a lane cannot
+        # freeze mid-block.
+        with pytest.raises(ValueError, match="k-block"):
+            eb.solve_ensemble(
+                problem, [eb.LaneSpec(stop_step=4)], path="kfused", k=2,
+                interpret=True,
+            )
+
+    def test_kfused_k_must_divide_n(self, problem):
+        with pytest.raises(ValueError, match="divide"):
+            eb.solve_ensemble(
+                problem, [eb.LaneSpec()], path="kfused", k=3,
+                interpret=True,
+            )
+
+    def test_solo_solvers_reject_phase_with_variable_c(self, problem):
+        # The solver-level twin of the lane check: a shifted phase has
+        # no analytic layer-1 bootstrap under variable c, and the solo
+        # APIs must refuse rather than silently initialize from the
+        # constant-speed solution.
+        field = np.full((problem.N,) * 3, problem.a2tau2)
+        with pytest.raises(ValueError, match="analytic"):
+            kfused.solve_kfused(
+                problem, k=2, interpret=True, compute_errors=False,
+                c2tau2_field=field, phase=1.0,
+            )
+        with pytest.raises(ValueError, match="analytic"):
+            leapfrog.solve(
+                problem,
+                step_fn=stencil_ref.make_variable_c_step(field),
+                compute_errors=False, phase=1.0,
+            )
+
+
+class TestPhaseAccuracy:
+    """The phase-shifted IVP has nonzero initial velocity u_t(0) =
+    -a_t sin(phase) Sx Sy Sz; without the tau * u_t(0) layer-1 term
+    (leapfrog.phase_velocity_coeff) the solver integrates a DIFFERENT
+    problem than the oracle measures and the reported "error" is O(1) -
+    the serving-path defect this suite pins against regression."""
+
+    def test_shifted_phase_errors_stay_discretization_small(self):
+        p = Problem(N=32, timesteps=20)
+        ref = leapfrog.solve(p).abs_errors.max()
+        for ph in (1.0, 0.5, 5.98):
+            e = leapfrog.solve(p, phase=ph).abs_errors.max()
+            # without the velocity term these sit at 0.27-0.94 (O(1));
+            # with it they are the same discretization class as the
+            # reference phase (~1e-3 at N=32/20 f32)
+            assert e < 10 * ref, f"phase={ph}: {e} vs ref {ref}"
+
+    def test_kfused_shifted_phase_accuracy(self):
+        p = Problem(N=32, timesteps=20)
+        e = kfused.solve_kfused(
+            p, k=4, interpret=True, phase=1.0
+        ).abs_errors.max()
+        assert e < 1e-2
+
+    def test_default_phase_is_the_reference_program(self, problem):
+        # phase=2*pi must be bit-identical to the phase-less call (the
+        # velocity term is statically absent at the reference phase).
+        a = leapfrog.solve(problem)
+        b = leapfrog.solve(problem, phase=2.0 * np.pi)
+        assert _bitwise(a.u_cur, b.u_cur)
+        assert np.array_equal(a.abs_errors, b.abs_errors)
+
+
+class TestResultShape:
+    def test_aggregate_throughput_sums_lanes(self, problem, lanes):
+        res = eb.solve_ensemble(problem, lanes, path="roll")
+        cells = sum(
+            problem.cells_per_step * lane.stop(problem) for lane in lanes
+        )
+        expect = cells / res.solve_seconds / 1e9
+        assert res.aggregate_gcells_per_second == pytest.approx(expect)
+
+    def test_error_arrays_trimmed_to_lane_stop(self, problem, lanes):
+        res = eb.solve_ensemble(problem, lanes, path="roll")
+        assert len(res.results[2].abs_errors) == 5 + 1
+        assert res.results[2].steps_computed == 5
+
+    def test_lane_spec_defaults(self, problem):
+        lane = eb.LaneSpec()
+        assert lane.stop(problem) == problem.timesteps
+        assert dataclasses.replace(lane, stop_step=3).stop(problem) == 3

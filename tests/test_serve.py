@@ -1,0 +1,424 @@
+"""Serve-layer contracts: engine cache, dynamic batcher, HTTP front end.
+
+The acceptance-level smoke test drives CONCURRENT HTTP requests at a live
+ThreadingHTTPServer and asserts they were coalesced into one batched
+solve (batch occupancy > 1 observed via /metrics) with each request
+receiving its own reference-format report - the end-to-end claim of
+`wavetpu serve`.  The watchdog test pins per-lane blast-radius: a
+Courant-unstable lane 422s while its batchmate's 200 stands.
+"""
+
+import json
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from wavetpu.core.problem import Problem
+from wavetpu.ensemble import batched as eb
+from wavetpu.serve.api import _c2_preset, build_server, parse_solve_request
+from wavetpu.serve.engine import ProgramKey, ServeEngine
+from wavetpu.serve.scheduler import (
+    DynamicBatcher,
+    ServeMetrics,
+    SolveRequest,
+)
+
+
+def _bitwise(a, b):
+    return np.array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---- engine ----
+
+class TestEngine:
+    def test_bucket_for(self):
+        eng = ServeEngine(bucket_sizes=(1, 2, 4, 8), interpret=True)
+        assert eng.bucket_for(1) == 1
+        assert eng.bucket_for(3) == 4
+        assert eng.bucket_for(8) == 8
+        with pytest.raises(ValueError, match="exceed"):
+            eng.bucket_for(9)
+
+    def test_program_cache_hits_misses_eviction(self):
+        eng = ServeEngine(
+            bucket_sizes=(1, 2), max_programs=1, interpret=True
+        )
+        p1 = Problem(N=8, timesteps=3)
+        p2 = Problem(N=8, timesteps=4)
+        a = eng.program(p1, "standard", "roll", 1, "f32", False, 2)
+        assert a is not None and eng.misses == 1 and eng.hits == 0
+        b = eng.program(p1, "standard", "roll", 1, "f32", False, 2)
+        assert b is a and eng.hits == 1
+        c = eng.program(p2, "standard", "roll", 1, "f32", False, 2)
+        assert c is not a
+        assert eng.evictions == 1
+        stats = eng.cache_stats()
+        assert stats["programs"] == 1
+        assert stats["misses"] == 2
+
+    def test_solve_pads_to_bucket(self):
+        eng = ServeEngine(bucket_sizes=(1, 2, 4), interpret=True)
+        p = Problem(N=8, timesteps=3)
+        lanes = [eb.LaneSpec(), eb.LaneSpec(phase=1.0), eb.LaneSpec()]
+        res, health = eng.solve(p, lanes, path="roll")
+        assert res.batch_size == 4
+        assert res.n_lanes == 3
+        assert health == [None, None, None]
+        assert res.batched
+
+    def test_warmup_precompiles(self):
+        eng = ServeEngine(bucket_sizes=(1, 2), interpret=True)
+        p = Problem(N=8, timesteps=3)
+        warmed = eng.warmup(p, path="roll")
+        assert warmed == [1, 2]
+        assert eng.misses == 2
+        eng.solve(p, [eb.LaneSpec()], path="roll")
+        assert eng.hits == 1  # served from the warmed program
+
+    def test_compensated_scheme_falls_back_recorded(self):
+        eng = ServeEngine(bucket_sizes=(1, 2), interpret=True)
+        p = Problem(N=8, timesteps=3)
+        res, health = eng.solve(p, [eb.LaneSpec()], scheme="compensated")
+        assert res.batched is False
+        assert "compensated" in res.fallback_reason
+        assert any(
+            k.startswith("scheme:") for k in eng.cache_stats()["fallbacks"]
+        )
+
+    def test_watchdog_isolates_poisoned_lane(self):
+        # C = 0.55: stable under constant c^2 = a^2, but the two-layer
+        # preset DOUBLES c^2 in half the domain (c * sqrt2 -> C = 0.78,
+        # past the leapfrog bound) - that lane blows up while its
+        # batchmate stays bounded.
+        p = Problem(N=8, T=26.0, timesteps=60)
+        eng = ServeEngine(bucket_sizes=(1, 2), interpret=True)
+        lanes = [
+            eb.LaneSpec(c2tau2_field=_c2_preset(p, "constant")),
+            eb.LaneSpec(c2tau2_field=_c2_preset(p, "two-layer")),
+        ]
+        res, health = eng.solve(p, lanes, path="roll")
+        assert health[0] is None
+        assert health[1] is not None and "amax" in health[1]
+        amax0 = float(np.abs(np.asarray(res.results[0].u_cur)).max())
+        assert amax0 < 10.0  # the healthy lane is untouched
+
+    def test_guarded_amax_per_lane_semantics(self):
+        from wavetpu.run import health
+
+        batch = np.stack([
+            np.ones((4, 4, 4)),
+            np.full((4, 4, 4), np.nan),
+            np.full((4, 4, 4), 7.0),
+        ])
+        out = health.guarded_amax_per_lane(batch)
+        assert out.shape == (3,)
+        assert out[0] == 1.0
+        assert np.isinf(out[1])  # NaN anywhere -> +inf, as guarded_amax
+        assert out[2] == 7.0
+        # agrees with the solo guard lane by lane
+        for i in range(3):
+            assert out[i] == health.guarded_amax(batch[i])
+
+    def test_watchdog_can_be_disabled(self):
+        p = Problem(N=8, T=26.0, timesteps=60)
+        eng = ServeEngine(
+            bucket_sizes=(1,), interpret=True, watchdog=False,
+        )
+        _, health = eng.solve(
+            p, [eb.LaneSpec(c2tau2_field=_c2_preset(p, "two-layer"))],
+            path="roll",
+        )
+        assert health == [None]
+
+
+# ---- scheduler (fake engine: batching logic only) ----
+
+class _FakeEngine:
+    """Engine stub recording batch compositions."""
+
+    max_batch = 4
+
+    def __init__(self, fail=False):
+        self.batches = []
+        self.fail = fail
+
+    def solve(self, problem, lanes, scheme, path, k, dtype_name):
+        if self.fail:
+            raise RuntimeError("engine exploded")
+        self.batches.append(len(lanes))
+        results = [
+            types.SimpleNamespace(steps_computed=problem.timesteps)
+            for _ in lanes
+        ]
+        res = types.SimpleNamespace(
+            results=results, n_lanes=len(lanes), batch_size=len(lanes),
+            batched=True, fallback_reason=None, path=path,
+            solve_seconds=0.01, aggregate_gcells_per_second=1.0,
+        )
+        return res, [None] * len(lanes)
+
+
+def _req(problem, **kw):
+    return SolveRequest(problem=problem, lane=eb.LaneSpec(**kw))
+
+
+class TestBatcher:
+    def test_concurrent_same_key_requests_coalesce(self):
+        eng = _FakeEngine()
+        metrics = ServeMetrics()
+        b = DynamicBatcher(eng, metrics=metrics, max_wait=0.5)
+        p = Problem(N=8, timesteps=3)
+        futs = [b.submit(_req(p, phase=1.0 + i)) for i in range(3)]
+        out = [f.result(10) for f in futs]
+        b.close()
+        assert eng.batches == [3]
+        assert all(o[2]["occupancy"] == 3 for o in out)
+        snap = metrics.snapshot()
+        assert snap["batches_total"] == 1
+        assert snap["batch_occupancy_max"] == 3
+
+    def test_different_keys_never_share_a_batch(self):
+        eng = _FakeEngine()
+        b = DynamicBatcher(eng, max_wait=0.3)
+        pa = Problem(N=8, timesteps=3)
+        pb = Problem(N=8, timesteps=4)
+        fa = b.submit(_req(pa))
+        fb = b.submit(_req(pb))
+        fa.result(10)
+        fb.result(10)
+        b.close()
+        assert sorted(eng.batches) == [1, 1]
+
+    def test_max_batch_closes_the_batch_early(self):
+        eng = _FakeEngine()
+        b = DynamicBatcher(eng, max_wait=30.0, max_batch=2)
+        p = Problem(N=8, timesteps=3)
+        t0 = time.monotonic()
+        futs = [b.submit(_req(p, phase=1.0 + i)) for i in range(2)]
+        for f in futs:
+            f.result(10)
+        took = time.monotonic() - t0
+        b.close()
+        assert eng.batches == [2]
+        assert took < 5.0  # did not sit out the 30 s max_wait
+
+    def test_engine_failure_propagates_to_every_future(self):
+        b = DynamicBatcher(_FakeEngine(fail=True), max_wait=0.2)
+        p = Problem(N=8, timesteps=3)
+        futs = [b.submit(_req(p, phase=1.0 + i)) for i in range(2)]
+        for f in futs:
+            with pytest.raises(RuntimeError, match="engine exploded"):
+                f.result(10)
+        b.close()
+
+    def test_bucket_key_separates_program_identities(self):
+        p = Problem(N=8, timesteps=3)
+        base = _req(p)
+        assert base.bucket_key() == _req(p, phase=2.0).bucket_key()
+        other = SolveRequest(problem=p, lane=eb.LaneSpec(), dtype_name="f64")
+        assert base.bucket_key() != other.bucket_key()
+        kf = SolveRequest(problem=p, lane=eb.LaneSpec(), path="kfused", k=2)
+        assert base.bucket_key() != kf.bucket_key()
+
+
+# ---- request parsing ----
+
+class TestParse:
+    def test_minimal_request(self):
+        req = parse_solve_request({"N": 8}, default_kernel="roll")
+        assert req.problem.N == 8
+        assert req.path == "roll"
+        assert req.k == 1
+
+    def test_fuse_steps_selects_kfused(self):
+        req = parse_solve_request(
+            {"N": 8, "fuse_steps": 2, "kernel": "pallas"},
+            default_kernel="roll",
+        )
+        assert req.path == "kfused" and req.k == 2
+
+    def test_fuse_steps_rejects_roll(self):
+        with pytest.raises(ValueError, match="pallas"):
+            parse_solve_request(
+                {"N": 8, "fuse_steps": 2, "kernel": "roll"},
+                default_kernel="roll",
+            )
+
+    def test_pi_lengths_and_preset_fields(self):
+        req = parse_solve_request(
+            {"N": 8, "Lx": "pi", "c2_field": "gaussian-lens"},
+            default_kernel="roll",
+        )
+        assert req.problem.Lx == pytest.approx(np.pi)
+        assert req.lane.c2tau2_field is not None
+
+    def test_bad_fields_rejected(self):
+        for body, msg in [
+            ({}, "missing required field N"),
+            ({"N": 8, "scheme": "x"}, "scheme"),
+            ({"N": 8, "dtype": "f16"}, "dtype"),
+            ({"N": 8, "c2_field": "nope"}, "c2_field"),
+            ({"N": 8, "steps": 99}, "stop_step"),
+            ({"N": 8, "scheme": "compensated", "phase": 1.0},
+             "reference phase"),
+            ({"N": 8, "scheme": "compensated", "c2_field": "constant"},
+             "c2_field"),
+            ({"N": 8, "phase": 1.0, "c2_field": "constant"},
+             "analytic layer-1"),
+        ]:
+            with pytest.raises(ValueError, match=msg):
+                parse_solve_request(body, default_kernel="roll")
+
+
+# ---- HTTP end to end ----
+
+@pytest.fixture()
+def server():
+    httpd, state = build_server(
+        port=0, max_wait=0.5, default_kernel="roll", interpret=True
+    )
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield base, state
+    httpd.shutdown()
+    state.batcher.close()
+    httpd.server_close()
+
+
+def _post(base, body, timeout=120):
+    req = urllib.request.Request(
+        base + "/solve", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestHTTP:
+    def test_concurrent_requests_coalesce_with_own_reports(self, server):
+        base, state = server
+        results = [None] * 4
+        phases = [6.283, 1.0, 0.5, 0.25]
+
+        def worker(i):
+            results[i] = _post(
+                base, {"N": 8, "timesteps": 4, "phase": phases[i]}
+            )
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        errs = set()
+        for code, body in results:
+            assert code == 200
+            assert body["status"] == "ok"
+            assert body["batch"]["occupancy"] > 1
+            assert body["report"]["final_step"] == 4
+            assert len(body["report"]["abs_errors"]) == 5
+            assert "grids initialized in" in body["report_text"]
+            errs.add(body["report"]["max_abs_error"])
+        # four distinct phases -> four distinct per-request reports
+        assert len(errs) == 4
+        code, metrics = _get(base, "/metrics")
+        assert code == 200
+        assert metrics["batch_occupancy_max"] > 1
+        assert metrics["requests_total"] == 4
+        assert metrics["responses_ok"] == 4
+        assert metrics["aggregate_gcells_per_s"] is not None
+        assert metrics["latency_p50_ms"] is not None
+        assert metrics["program_cache"]["programs"] >= 1
+
+    def test_healthz(self, server):
+        base, _ = server
+        code, body = _get(base, "/healthz")
+        assert code == 200
+        assert body["status"] == "ok"
+
+    def test_bad_request_400(self, server):
+        base, _ = server
+        code, body = _post(base, {"timesteps": 4})
+        assert code == 400
+        assert "N" in body["error"]
+
+    def test_unknown_route_404(self, server):
+        base, _ = server
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/nope", timeout=30)
+        assert ei.value.code == 404
+
+    def test_watchdog_poisoned_request_422_batchmate_ok(self, server):
+        base, _ = server
+        results = [None] * 2
+        bodies = [
+            {"N": 8, "T": 26.0, "timesteps": 60, "c2_field": "constant"},
+            {"N": 8, "T": 26.0, "timesteps": 60, "c2_field": "two-layer"},
+        ]
+
+        def worker(i):
+            results[i] = _post(base, bodies[i], timeout=300)
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        codes = sorted(r[0] for r in results)
+        assert codes == [200, 422]
+        bad = next(b for c, b in results if c == 422)
+        assert "amax" in bad["error"]
+        ok = next(b for c, b in results if c == 200)
+        # a field request serves without the analytic oracle
+        assert ok["report"]["errors_computed"] is False
+        assert ok["report"]["max_abs_error"] is None
+
+
+# ---- CLI entry points ----
+
+class TestCLI:
+    def test_wavetpu_version(self, capsys):
+        from wavetpu import __version__
+        from wavetpu.cli import main
+
+        assert main(["--version"]) == 0
+        assert __version__ in capsys.readouterr().out
+
+    def test_wavetpu_serve_version(self, capsys):
+        from wavetpu import __version__
+        from wavetpu.cli import main
+
+        assert main(["serve", "--version"]) == 0
+        out = capsys.readouterr().out
+        assert "wavetpu-serve" in out and __version__ in out
+
+    def test_serve_rejects_unknown_flag(self, capsys):
+        from wavetpu.cli import main
+
+        assert main(["serve", "--frobnicate", "1"]) == 2
+
+    def test_program_key_shape(self):
+        p = Problem(N=8, timesteps=3)
+        key = ProgramKey.for_batch(
+            p, "standard", "roll", 4, "f32", False, True, 2
+        )
+        assert key.k == 1  # non-kfused paths normalize k
+        assert key.batch == 2

@@ -144,6 +144,11 @@ Exit codes (docs/robustness.md): 0 complete; 2 usage or checkpoint-load
 error; 3 preempted but checkpointed (requeue + --resume); 4 numerical-
 health halt with the last-good checkpoint preserved (page an operator).
 Non-zero supervised exits print `resumable checkpoint: PATH`.
+
+Subcommands: `wavetpu serve [...]` starts the batched-inference HTTP
+front end (wavetpu/serve/api.py, also installed as `wavetpu-serve`;
+endpoint contract in docs/serving.md).  `wavetpu --version` prints the
+package version (both entry points accept it).
 """
 
 from __future__ import annotations
@@ -211,6 +216,17 @@ def _split_flags(argv: Sequence[str]) -> Tuple[List[str], dict]:
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "serve":
+        # The serving front end is its own flag namespace; dispatch before
+        # the solver CLI's parser can reject it.
+        from wavetpu.serve import api as serve_api
+
+        return serve_api.main(argv[1:])
+    if "--version" in argv:
+        from wavetpu import __version__
+
+        print(f"wavetpu {__version__}")
+        return 0
     try:
         pos, flags = _split_flags(argv)
         if flags.get("dtype", "f32") not in ("f32", "f64", "bf16"):
@@ -327,7 +343,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         print(
-            "usage: wavetpu N Np Lx Ly Lz [T] [timesteps] "
+            "usage: wavetpu N Np Lx Ly Lz [T] [timesteps] | "
+            "wavetpu serve [...] | wavetpu --version\n"
+            "       wavetpu N Np Lx Ly Lz [T] [timesteps] "
             "[--backend auto|single|sharded] [--mesh MX,MY,MZ] "
             "[--dtype f32|f64|bf16] [--kernel auto|roll|pallas] "
             "[--fuse-steps K] [--scheme standard|compensated] "
@@ -561,36 +579,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from wavetpu.kernels import stencil_ref
 
         spec = flags["c2-field"]
-        a2 = problem.a2
-
-        def _gaussian_lens(x, y, z):
-            # A slow-speed lens: c^2 dips to a2/2 at the domain centre.
-            s2 = 2.0 * (problem.Lx / 8.0) ** 2
-            r2 = (
-                (x - problem.Lx / 2) ** 2
-                + (y - problem.Ly / 2) ** 2
-                + (z - problem.Lz / 2) ** 2
-            )
-            return a2 * (1.0 - 0.5 * np.exp(-r2 / s2))
-
-        presets = {
-            "constant": lambda x, y, z: a2 * np.ones_like(x + y + z),
-            "gaussian-lens": _gaussian_lens,
-            # A discontinuous interface: the far z half runs 2x faster.
-            "two-layer": lambda x, y, z: np.where(
-                z < problem.Lz / 2, a2, 2.0 * a2
-            ) + 0.0 * x + 0.0 * y,
-        }
-        if spec in presets:
-            c2_field = stencil_ref.make_c2tau2_field(problem, presets[spec])
+        # Preset table shared with the serving API
+        # (stencil_ref.make_preset_c2tau2_field): one source of truth,
+        # so a preset name means the same physics on both surfaces.
+        if spec in stencil_ref.C2_PRESET_NAMES:
+            c2_field = stencil_ref.make_preset_c2tau2_field(problem, spec)
         else:
             try:
                 arr = np.load(spec)
             except Exception as e:
                 print(
                     f"error: --c2-field {spec!r} is neither a preset "
-                    f"({', '.join(sorted(presets))}) nor a loadable .npy "
-                    f"file: {e}",
+                    f"({', '.join(sorted(stencil_ref.C2_PRESET_NAMES))}) "
+                    f"nor a loadable .npy file: {e}",
                     file=sys.stderr,
                 )
                 return 2

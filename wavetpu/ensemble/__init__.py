@@ -1,0 +1,24 @@
+"""Ensemble-batched solves: many independent problems in ONE XLA program.
+
+`batched.py` vmaps the existing step families over a leading lane axis -
+the throughput model of the TPU fluid-flow framework (arXiv:2108.11076):
+aggregate Gcell/s comes from keeping B independent simulations resident
+as one batched program, not from more single-run tuning.  The serve layer
+(wavetpu/serve) sits on top.
+"""
+
+from wavetpu.ensemble.batched import (
+    EnsembleResult,
+    EnsembleSolver,
+    LaneSpec,
+    solve_ensemble,
+    vmap_capability,
+)
+
+__all__ = [
+    "EnsembleResult",
+    "EnsembleSolver",
+    "LaneSpec",
+    "solve_ensemble",
+    "vmap_capability",
+]

@@ -1,0 +1,807 @@
+"""Vmapped ensemble core: a batch of independent solves as one program.
+
+A batch (an "ensemble") shares the compiled-program identity - (N, Lx/y/z,
+T, timesteps, scheme, kernel path, k, dtype, batch size) - while each LANE
+differs in
+
+ * the initial time phase of the analytic solution (`LaneSpec.phase`;
+   u(0) = Sx*Sy*Sz * cos(phase), which solves the PDE for any phase, so
+   the per-lane error oracle stays exact),
+ * the number of layers marched (`LaneSpec.stop_step`: the batch marches
+   to the max and earlier-stopping lanes are FROZEN by `where` masking,
+   which preserves their state bit-for-bit), and
+ * optionally a per-lane tau^2 c^2(x,y,z) field (no analytic oracle, so
+   field batches require compute_errors=False).
+
+Wired paths: "roll" (the jnp stencil), "pallas" (the fused 1-step slab
+kernel), "kfused" (the k-step onion, k >= 2).  Each lane's op sequence
+inside the vmapped program mirrors the corresponding solo solver's
+(leapfrog.make_solver / kfused.make_kfused_solver) op for op - the
+BITWISE lane-parity contract is pinned by tests/test_ensemble.py, and any
+change here or there must keep that suite green.
+
+Not every path vmaps on every backend (Mosaic's batching support for the
+onion kernels differs from interpret mode's).  `vmap_capability` probes a
+tiny batched solve per (path, backend) once and caches the verdict; a
+failed probe - or the compensated scheme, which is not wired into the
+vmapped core - drops to the LANE-LOOP fallback (sequential solo solves
+behind the same EnsembleResult interface) with the reason RECORDED in
+`EnsembleResult.fallback_reason`.  Nothing falls back silently.
+
+Per-lane timestep masking on the "kfused" path freezes whole k-blocks, so
+a lane's stop_step must sit on the block grid ((stop-1) % k == 0) or be
+the full march; the 1-step paths mask per layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from wavetpu.core.problem import Problem
+from wavetpu.verify import oracle
+
+PATHS = ("roll", "pallas", "kfused")
+
+
+@dataclasses.dataclass(frozen=True)
+class LaneSpec:
+    """One lane of an ensemble batch.
+
+    `phase`: initial time phase of the analytic solution (reference: 2*pi).
+    `stop_step`: layers to march (None = the problem's timesteps; the lane
+    freezes there while the batch marches on).  `c2tau2_field`: optional
+    host (N,N,N) tau^2 c^2 array (stencil_ref.make_c2tau2_field).
+    """
+
+    phase: float = oracle.TWO_PI
+    stop_step: Optional[int] = None
+    c2tau2_field: Optional[object] = None
+
+    def stop(self, problem: Problem) -> int:
+        return (
+            problem.timesteps if self.stop_step is None else self.stop_step
+        )
+
+
+def padding_lane() -> LaneSpec:
+    """The masked filler lane the serve layer pads batches with: frozen
+    after layer 1 (stop=1 sits on every k-block grid), default phase.
+    Padding lanes ride the batch axis only - elementwise across lanes -
+    so real lanes are bitwise unchanged (tests/test_ensemble.py pins it).
+    """
+    return LaneSpec(stop_step=1)
+
+
+@dataclasses.dataclass
+class EnsembleResult:
+    """A batched solve's outcome: per-lane SolveResults + how it ran.
+
+    `batched` False means the lane-loop fallback executed (reason in
+    `fallback_reason` - never None in that case); `batch_size` counts the
+    compiled program's lanes including padding, `n_lanes` the real ones.
+    `solve_seconds` is the whole batch's wall time (each lane's
+    SolveResult carries the same number: lanes finish together).
+    """
+
+    problem: Problem
+    results: List["SolveResult"]  # noqa: F821 - from solver.leapfrog
+    path: str
+    batched: bool
+    fallback_reason: Optional[str]
+    batch_size: int
+    n_lanes: int
+    init_seconds: float
+    solve_seconds: float
+    # The raw (B, N, N, N) batched state (padding lanes included; None
+    # on the lane-loop fallback).  The serve engine's per-lane watchdog
+    # reduces over these directly - re-stacking the per-lane views
+    # would copy the whole batch state per request batch.
+    u_prev_batch: Optional[object] = None
+    u_cur_batch: Optional[object] = None
+
+    @property
+    def aggregate_gcells_per_second(self) -> float:
+        """Sum of per-lane cell-updates over the batch wall time - the
+        serving throughput number (arXiv:2108.11076's batching win)."""
+        if not self.solve_seconds:
+            return 0.0
+        total = sum(
+            self.problem.cells_per_step * (r.steps_computed or 0)
+            for r in self.results
+        )
+        return total / self.solve_seconds / 1e9
+
+
+def _validate(problem: Problem, lanes: Sequence[LaneSpec], path: str,
+              k: int, compute_errors: bool) -> bool:
+    """Shared lane validation; returns with_field (all-or-none normalized
+    by the caller via `fill_fields`)."""
+    if path not in PATHS:
+        raise ValueError(f"path must be one of {PATHS}, got {path!r}")
+    if not lanes:
+        raise ValueError("an ensemble needs at least one lane")
+    if path == "kfused":
+        if k < 2:
+            raise ValueError(f"kfused path needs k >= 2, got {k}")
+        if problem.N % k:
+            raise ValueError(f"k={k} must divide N={problem.N}")
+    with_field = any(lane.c2tau2_field is not None for lane in lanes)
+    if with_field and compute_errors:
+        raise ValueError(
+            "per-lane c2tau2 fields have no analytic oracle; pass "
+            "compute_errors=False"
+        )
+    for i, lane in enumerate(lanes):
+        s = lane.stop(problem)
+        if not 1 <= s <= problem.timesteps:
+            raise ValueError(
+                f"lane {i}: stop_step must be in [1, {problem.timesteps}],"
+                f" got {s}"
+            )
+        if path == "kfused" and s != problem.timesteps and (s - 1) % k:
+            raise ValueError(
+                f"lane {i}: on the kfused path a lane freezes at whole "
+                f"k-blocks - stop_step must satisfy (stop-1) % {k} == 0 "
+                f"or equal timesteps={problem.timesteps}, got {s}"
+            )
+        if lane.c2tau2_field is not None and np.shape(
+            lane.c2tau2_field
+        ) != (problem.N,) * 3:
+            raise ValueError(
+                f"lane {i}: c2tau2_field shape "
+                f"{np.shape(lane.c2tau2_field)} != {(problem.N,) * 3}"
+            )
+        if with_field and lane.phase != oracle.TWO_PI:
+            # A shifted phase bootstraps layer 1 from the ANALYTIC
+            # solution, which only exists for constant speed - and in a
+            # field batch EVERY lane runs the variable-c kernel
+            # (fill_fields), so the whole batch must keep the reference
+            # phase.  (The serve scheduler never mixes these anyway:
+            # field presence is part of the bucket key.)
+            raise ValueError(
+                f"lane {i}: a shifted phase has no analytic layer-1 "
+                f"bootstrap in a variable-c field batch; use the "
+                f"reference phase with c2tau2_field"
+            )
+    return with_field
+
+
+def fill_fields(problem: Problem, lanes: Sequence[LaneSpec]) -> list:
+    """In a field batch every lane runs the variable-c kernel, so lanes
+    without a field get the CONSTANT tau^2 a^2 field (numerically the
+    constant-speed problem; bitwise it matches the solo variable-c solve
+    with that constant field, not the constant-c kernel - documented in
+    docs/serving.md)."""
+    const = None
+    out = []
+    for lane in lanes:
+        if lane.c2tau2_field is None:
+            if const is None:
+                const = np.full(
+                    (problem.N,) * 3, problem.a2tau2, dtype=np.float64
+                )
+            lane = dataclasses.replace(lane, c2tau2_field=const)
+        out.append(lane)
+    return out
+
+
+def _lane_error_fn(problem: Problem, dtype):
+    """(u, n, ct_table) -> (abs_e, rel_e): leapfrog._error_fn with the
+    time-factor table as a runtime argument instead of a closed-over
+    constant (per-lane tables ride the batch axis).  Must stay op-for-op
+    identical to leapfrog._error_fn for the bitwise parity contract."""
+    import jax.numpy as jnp
+
+    from wavetpu.kernels import stencil_ref
+
+    f_dtype = stencil_ref.compute_dtype(dtype)
+    sx, sy, sz = oracle.spatial_factors(problem, f_dtype)
+    mask = jnp.asarray(oracle.interior_masks_1d(problem.N))
+
+    def errors(u, n, ct_table):
+        fld = oracle.analytic_field(sx, sy, sz, ct_table[n])
+        return oracle.layer_errors(u.astype(f_dtype), fld, mask, mask, mask)
+
+    return errors
+
+
+def _bootstrap(problem: Problem, dtype, sx, sy, sz, ct_table, taylor,
+               step, params):
+    """Layers 0/1 from a runtime ct table.
+
+    `taylor` is the lane's per-lane bootstrap selector: True = the
+    reference's step-derived Taylor half-step (valid only at the
+    reference phase, where u_t(0) = 0), False = the exact analytic
+    layer-1 initialization shifted phases need (see
+    leapfrog.make_solver).  The `where` reproduces the solo solver's
+    STATIC phase decision at runtime, selecting bitwise between two
+    branches that each mirror the corresponding solo program op for op.
+    """
+    import jax.numpy as jnp
+
+    from wavetpu.kernels import stencil_ref
+
+    f = stencil_ref.compute_dtype(dtype)
+    u0 = stencil_ref.apply_dirichlet(
+        oracle.analytic_field(sx, sy, sz, ct_table[0])
+    ).astype(dtype)
+    u1_step = (
+        0.5 * (u0.astype(f) + step(u0, u0, problem, params).astype(f))
+    ).astype(dtype)
+    u1_analytic = stencil_ref.apply_dirichlet(
+        oracle.analytic_field(sx, sy, sz, ct_table[1])
+    ).astype(dtype)
+    return u0, jnp.where(taylor, u1_step, u1_analytic)
+
+
+def _step1_pair(problem: Problem, path: str, block_x, interpret,
+                with_field):
+    """(fn4, default_params) for the batch's 1-step kernel: the roll or
+    pallas step in leapfrog's 4-arg ParamStep form.  For field batches the
+    fn takes the per-lane field as its params argument (the throwaway
+    ParamStep built here only donates its .fn; its dummy params are never
+    used)."""
+    from wavetpu.kernels import stencil_ref
+    from wavetpu.solver import leapfrog
+
+    if path == "roll":
+        if with_field:
+            return stencil_ref.make_variable_c_step(
+                np.zeros((1, 1, 1))
+            ).fn, ()
+        return leapfrog._as_param_step(None)
+    from wavetpu.kernels import stencil_pallas
+
+    if with_field:
+        return stencil_pallas.make_step_fn(
+            block_x=block_x, interpret=interpret,
+            c2tau2_field=np.zeros((1, 1, 1)),
+        ).fn, ()
+    return leapfrog._as_param_step(
+        stencil_pallas.make_step_fn(block_x=block_x, interpret=interpret)
+    )
+
+
+class EnsembleSolver:
+    """The compiled batched program for one (problem, path, batch) key.
+
+    Built once, reused across batches - this is the object the serve
+    layer's program cache holds.  `compile()` ahead-of-time lowers the
+    vmapped march (warm-up without executing a solve); `run(lanes)`
+    executes it on a packed batch and returns per-lane SolveResults.
+
+    The lane program vmapped here mirrors the solo solver's op sequence
+    exactly; tests/test_ensemble.py pins bitwise lane parity.
+    """
+
+    def __init__(
+        self,
+        problem: Problem,
+        n_lanes: int,
+        dtype=None,
+        path: str = "roll",
+        k: int = 4,
+        compute_errors: bool = True,
+        interpret: Optional[bool] = None,
+        block_x: Optional[int] = None,
+        with_field: bool = False,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        from wavetpu.kernels import stencil_ref
+
+        if n_lanes < 1:
+            raise ValueError(f"n_lanes must be >= 1, got {n_lanes}")
+        if path not in PATHS:
+            raise ValueError(f"path must be one of {PATHS}, got {path!r}")
+        if path == "kfused":
+            if k < 2:
+                raise ValueError(f"kfused path needs k >= 2, got {k}")
+            if problem.N % k:
+                raise ValueError(f"k={k} must divide N={problem.N}")
+        if with_field and compute_errors:
+            raise ValueError(
+                "field batches have no analytic oracle; pass "
+                "compute_errors=False"
+            )
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        self.problem = problem
+        self.n_lanes = n_lanes
+        self.dtype = jnp.float32 if dtype is None else dtype
+        self.path = path
+        self.k = k if path == "kfused" else 1
+        self.compute_errors = compute_errors
+        self.with_field = with_field
+        self._f = stencil_ref.compute_dtype(self.dtype)
+        self._exec = None
+        self.compile_seconds: Optional[float] = None
+        lane_run = (
+            self._kfused_lane(interpret, block_x)
+            if path == "kfused"
+            else self._onestep_lane(interpret, block_x)
+        )
+        in_axes = (0, 0, 0, 0) if with_field else (0, 0, 0)
+        self._runner = jax.jit(jax.vmap(lane_run, in_axes=in_axes))
+
+    # ---- lane programs (solo op sequences with runtime ct tables) ----
+
+    def _onestep_lane(self, interpret, block_x):
+        import jax.numpy as jnp
+        from jax import lax
+
+        problem, dtype, f = self.problem, self.dtype, self._f
+        compute_errors = self.compute_errors
+        sx, sy, sz = oracle.spatial_factors(problem, f)
+        errors = _lane_error_fn(problem, dtype)
+        step, params0 = _step1_pair(
+            problem, self.path, block_x, interpret, self.with_field
+        )
+
+        def lane_run(ct_table, stop, taylor, *field):
+            params = field[0] if self.with_field else params0
+            u0, u1 = _bootstrap(
+                problem, dtype, sx, sy, sz, ct_table, taylor, step, params
+            )
+            a0 = r0 = jnp.zeros((), f)
+            if compute_errors:
+                a1, r1 = errors(u1, 1, ct_table)
+            else:
+                a1 = r1 = jnp.zeros((), f)
+
+            def body(carry, n):
+                u_prev, u = carry
+                u_next = step(u_prev, u, problem, params)
+                live = n <= stop
+                if compute_errors:
+                    ae, re = errors(u_next, n, ct_table)
+                    ae = jnp.where(live, ae, jnp.zeros((), f))
+                    re = jnp.where(live, re, jnp.zeros((), f))
+                else:
+                    ae = re = jnp.zeros((), f)
+                return (
+                    jnp.where(live, u, u_prev),
+                    jnp.where(live, u_next, u),
+                ), (ae, re)
+
+            (u_prev, u_cur), (abs_t, rel_t) = lax.scan(
+                body, (u0, u1), jnp.arange(2, problem.timesteps + 1)
+            )
+            return (
+                u_prev,
+                u_cur,
+                jnp.concatenate([jnp.stack([a0, a1]), abs_t]),
+                jnp.concatenate([jnp.stack([r0, r1]), rel_t]),
+            )
+
+        return lane_run
+
+    def _kfused_lane(self, interpret, block_x):
+        import jax.numpy as jnp
+        from jax import lax
+
+        from wavetpu.kernels import stencil_pallas
+        from wavetpu.solver import kfused, leapfrog
+
+        problem, dtype, f = self.problem, self.dtype, self._f
+        k, compute_errors = self.k, self.compute_errors
+        sx, _ct, syz, rsyz, xmask, inv_absx = kfused._oracle_parts(
+            problem, f
+        )
+        _, sy, sz = oracle.spatial_factors(problem, f)
+        errors = _lane_error_fn(problem, dtype)
+        step1, params0 = _step1_pair(
+            problem, "pallas", block_x, interpret, self.with_field
+        )
+        nsteps = problem.timesteps
+        nblocks = (nsteps - 1) // k
+        rem = (nsteps - 1) - nblocks * k
+
+        def lane_run(ct_table, stop, taylor, *field):
+            params = field[0] if self.with_field else params0
+            u0, u1 = _bootstrap(
+                problem, dtype, sx, sy, sz, ct_table, taylor, step1, params
+            )
+            a0 = r0 = jnp.zeros((), f)
+            if compute_errors:
+                a1, r1 = errors(u1, 1, ct_table)
+            else:
+                a1 = r1 = jnp.zeros((), f)
+
+            def kblock(carry, nstart):
+                u_prev, u = carry
+                ctk = lax.dynamic_slice(ct_table, (nstart + 1,), (k,))
+                sxct = ctk[:, None] * sx[None, :]
+                up, uc, dmax, rmax = stencil_pallas.fused_kstep(
+                    u_prev, u, syz, rsyz, sxct,
+                    k=k, coeff=problem.a2tau2, inv_h2=problem.inv_h2,
+                    c2tau2_field=field[0] if self.with_field else None,
+                    block_x=block_x, interpret=interpret,
+                    with_errors=compute_errors,
+                )
+                if compute_errors:
+                    abs_e, rel_e = kfused._block_errors(
+                        dmax, rmax, ctk, xmask, inv_absx
+                    )
+                else:
+                    abs_e = rel_e = jnp.zeros((k,), f)
+                # A lane freezes at whole blocks: live iff the block's
+                # last layer is within the lane's march.
+                live = nstart + k <= stop
+                return (
+                    jnp.where(live, up, u_prev),
+                    jnp.where(live, uc, u),
+                ), (
+                    jnp.where(live, abs_e, jnp.zeros((k,), f)),
+                    jnp.where(live, rel_e, jnp.zeros((k,), f)),
+                )
+
+            starts = 1 + k * jnp.arange(nblocks)
+            (u_prev, u_cur), (abs_b, rel_b) = lax.scan(
+                kblock, (u0, u1), starts
+            )
+            abs_parts = [abs_b.reshape(-1)]
+            rel_parts = [rel_b.reshape(-1)]
+            if rem:
+                # The uniform remainder tail marches the 1-step kernel,
+                # masked per layer (as the solo kfused march's tail would,
+                # for lanes stopping before it).
+                def body(carry, n):
+                    u_prev, u = carry
+                    u_next = step1(u_prev, u, problem, params)
+                    live = n <= stop
+                    if compute_errors:
+                        ae, re = errors(u_next, n, ct_table)
+                        ae = jnp.where(live, ae, jnp.zeros((), f))
+                        re = jnp.where(live, re, jnp.zeros((), f))
+                    else:
+                        ae = re = jnp.zeros((), f)
+                    return (
+                        jnp.where(live, u, u_prev),
+                        jnp.where(live, u_next, u),
+                    ), (ae, re)
+
+                (u_prev, u_cur), (ra, rr) = lax.scan(
+                    body, (u_prev, u_cur),
+                    nsteps - rem + 1 + jnp.arange(rem, dtype=jnp.int32),
+                )
+                abs_parts.append(ra)
+                rel_parts.append(rr)
+            return (
+                u_prev,
+                u_cur,
+                jnp.concatenate(
+                    [jnp.stack([a0, a1])] + abs_parts
+                ),
+                jnp.concatenate(
+                    [jnp.stack([r0, r1])] + rel_parts
+                ),
+            )
+
+        return lane_run
+
+    # ---- packing / compiling / running ----
+
+    def pack(self, lanes: Sequence[LaneSpec]) -> Tuple:
+        """Device arguments for a padded batch: (B, T+1) ct tables, (B,)
+        stop layers, and (B, N, N, N) fields when the batch carries them
+        (caller has already run `fill_fields`)."""
+        import jax.numpy as jnp
+
+        if len(lanes) != self.n_lanes:
+            raise ValueError(
+                f"batch has {len(lanes)} lanes; this program wants "
+                f"{self.n_lanes} (pad with padding_lane())"
+            )
+        cts = np.stack(
+            [
+                oracle.time_factor_table_np(self.problem, lane.phase)
+                for lane in lanes
+            ]
+        )
+        stops = np.asarray(
+            [lane.stop(self.problem) for lane in lanes], np.int32
+        )
+        # Per-lane bootstrap selector: the solo solvers' STATIC
+        # phase-equality decision, evaluated at pack time (see
+        # _bootstrap).
+        taylor = np.asarray(
+            [lane.phase == oracle.TWO_PI for lane in lanes], bool
+        )
+        args = (
+            jnp.asarray(cts, self._f),
+            jnp.asarray(stops),
+            jnp.asarray(taylor),
+        )
+        if self.with_field:
+            fields = np.stack(
+                [np.asarray(lane.c2tau2_field) for lane in lanes]
+            )
+            args = args + (jnp.asarray(fields, self._f),)
+        return args
+
+    def _example_args(self) -> Tuple:
+        import jax.numpy as jnp
+
+        b, t = self.n_lanes, self.problem.timesteps
+        args = (
+            jnp.zeros((b, t + 1), self._f),
+            jnp.ones((b,), jnp.int32),
+            jnp.ones((b,), bool),
+        )
+        if self.with_field:
+            args = args + (jnp.zeros((b,) + (self.problem.N,) * 3, self._f),)
+        return args
+
+    def compile(self) -> float:
+        """AOT lower + compile (the serve engine's warm-up); idempotent.
+        Returns the compile wall seconds (0.0 on a warm hit)."""
+        if self._exec is not None:
+            return 0.0
+        t0 = time.perf_counter()
+        self._exec = self._runner.lower(*self._example_args()).compile()
+        self.compile_seconds = time.perf_counter() - t0
+        return self.compile_seconds
+
+    def run(self, lanes: Sequence[LaneSpec]):
+        """Execute the batch; returns (outputs, init_seconds,
+        solve_seconds) with outputs = (u_prev_b, u_cur_b, abs_b, rel_b).
+        init_seconds is the compile time this call paid (0 when warm)."""
+        import jax
+
+        init_s = self.compile()
+        args = self.pack(lanes)
+        t0 = time.perf_counter()
+        out = self._exec(*args)
+        jax.block_until_ready(out)
+        # Readback proves execution on remote backends (the same reasoning
+        # as leapfrog._timed_compile_run's sync): the (B, T+1) error
+        # block is the smallest always-present output.
+        np.asarray(out[2])
+        solve_s = time.perf_counter() - t0
+        return out, init_s, solve_s
+
+
+def _lane_results(problem, outputs, lanes, init_s, solve_s):
+    """Per-lane SolveResults from batched outputs (padding already
+    dropped by the caller passing only real lanes and their indices)."""
+    from wavetpu.solver.leapfrog import SolveResult
+
+    upb, ucb, ab, rb = outputs
+    results = []
+    for i, lane in enumerate(lanes):
+        s = lane.stop(problem)
+        results.append(
+            SolveResult(
+                problem=problem,
+                u_prev=upb[i],
+                u_cur=ucb[i],
+                abs_errors=np.asarray(ab[i], np.float64)[: s + 1],
+                rel_errors=np.asarray(rb[i], np.float64)[: s + 1],
+                init_seconds=init_s,
+                solve_seconds=solve_s,
+                steps_computed=s,
+                final_step=s,
+            )
+        )
+    return results
+
+
+# ---- capability probe ----
+
+_PROBE_CACHE = {}
+
+
+def vmap_capability(
+    path: str,
+    k: int = 2,
+    interpret: Optional[bool] = None,
+    with_field: bool = False,
+) -> Tuple[bool, Optional[str]]:
+    """Does jax.vmap compose with this path's kernels on this backend?
+
+    Runs a tiny batched solve (N=8, 2 lanes) end to end once per
+    (path, with_field, backend) and caches the verdict.  Returns
+    (ok, reason): reason is the exception summary on failure - the string
+    `solve_ensemble` records in `EnsembleResult.fallback_reason` so a
+    fallback is never silent.
+    """
+    import jax
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    key = (path, bool(with_field), bool(interpret), jax.default_backend())
+    if key in _PROBE_CACHE:
+        return _PROBE_CACHE[key]
+    try:
+        tiny = Problem(N=8, timesteps=2 * max(2, k) + 1)
+        lanes = [LaneSpec(), LaneSpec(phase=1.0)]
+        if with_field:
+            lanes = fill_fields(tiny, lanes)
+        solver = EnsembleSolver(
+            tiny, len(lanes), path=path, k=min(k, 2) if path == "kfused"
+            else k, compute_errors=not with_field, interpret=interpret,
+            with_field=with_field,
+        )
+        out, _, _ = solver.run(lanes)
+        np.asarray(out[1])
+        verdict = (True, None)
+    except Exception as e:  # recorded, never raised: probe = capability
+        verdict = (False, f"{type(e).__name__}: {e}")
+    _PROBE_CACHE[key] = verdict
+    return verdict
+
+
+# ---- lane-loop fallback ----
+
+def _solve_lane_loop(
+    problem, lanes, dtype, scheme, path, k, compute_errors, interpret,
+    block_x, reason,
+):
+    """Sequential solo solves behind the EnsembleResult interface - the
+    recorded fallback when vmap does not compose (or for the compensated
+    scheme, which the vmapped core does not wire)."""
+    from wavetpu.kernels import stencil_pallas, stencil_ref
+    from wavetpu.solver import kfused, leapfrog
+
+    results = []
+    init_total = solve_total = 0.0
+    for lane in lanes:
+        s = lane.stop(problem)
+        if scheme == "compensated" and path == "kfused":
+            # The flagship velocity-form onion; served sequentially until
+            # the vmapped core wires the compensated scheme (ROADMAP).
+            from wavetpu.solver import kfused_comp
+
+            res = kfused_comp.solve_kfused_comp(
+                problem, dtype=dtype, k=k,
+                compute_errors=compute_errors, stop_step=s,
+                interpret=interpret,
+            )
+        elif scheme == "compensated":
+            comp_step = None
+            if path == "pallas":
+                comp_step = stencil_pallas.make_compensated_step_fn(
+                    interpret=interpret
+                )
+            res = leapfrog.solve_compensated(
+                problem, dtype=dtype, comp_step_fn=comp_step,
+                compute_errors=compute_errors, stop_step=s,
+            )
+        elif path == "kfused":
+            res = kfused.solve_kfused(
+                problem, dtype=dtype, k=k, compute_errors=compute_errors,
+                stop_step=s, block_x=block_x, interpret=interpret,
+                c2tau2_field=lane.c2tau2_field, phase=lane.phase,
+            )
+        else:
+            if lane.c2tau2_field is not None:
+                step_fn = (
+                    stencil_pallas.make_step_fn(
+                        block_x=block_x, interpret=interpret,
+                        c2tau2_field=lane.c2tau2_field,
+                    )
+                    if path == "pallas"
+                    else stencil_ref.make_variable_c_step(lane.c2tau2_field)
+                )
+            else:
+                step_fn = (
+                    stencil_pallas.make_step_fn(
+                        block_x=block_x, interpret=interpret
+                    )
+                    if path == "pallas"
+                    else None
+                )
+            res = leapfrog.solve(
+                problem, dtype=dtype, step_fn=step_fn,
+                compute_errors=compute_errors, stop_step=s,
+                phase=lane.phase,
+            )
+        init_total += res.init_seconds
+        solve_total += res.solve_seconds
+        results.append(res)
+    return EnsembleResult(
+        problem=problem,
+        results=results,
+        path=path,
+        batched=False,
+        fallback_reason=reason,
+        batch_size=len(lanes),
+        n_lanes=len(lanes),
+        init_seconds=init_total,
+        solve_seconds=solve_total,
+    )
+
+
+def solve_ensemble(
+    problem: Problem,
+    lanes: Sequence[LaneSpec],
+    dtype=None,
+    scheme: str = "standard",
+    path: str = "roll",
+    k: int = 4,
+    compute_errors: bool = True,
+    interpret: Optional[bool] = None,
+    block_x: Optional[int] = None,
+    pad_to: Optional[int] = None,
+    solver: Optional[EnsembleSolver] = None,
+) -> EnsembleResult:
+    """Solve a batch of lanes as one vmapped program (or the recorded
+    lane-loop fallback).
+
+    `pad_to` rounds the batch up to a program-cache bucket with masked
+    `padding_lane()`s (dropped from `results`).  Pass a pre-built
+    `solver` (the serve engine's cached program) to skip rebuilding; its
+    geometry must match.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    dtype = jnp.float32 if dtype is None else dtype
+    if scheme not in ("standard", "compensated"):
+        raise ValueError(
+            f"scheme must be standard|compensated, got {scheme!r}"
+        )
+    lanes = list(lanes)
+    with_field = _validate(problem, lanes, path, k, compute_errors)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if scheme == "compensated":
+        if with_field or any(
+            lane.phase != oracle.TWO_PI for lane in lanes
+        ):
+            raise ValueError(
+                "the compensated lane-loop supports the reference phase "
+                "and constant speed only (the vmapped core does not wire "
+                "the compensated scheme yet)"
+            )
+        return _solve_lane_loop(
+            problem, lanes, dtype, scheme, path, k, compute_errors,
+            interpret, block_x,
+            "compensated scheme is not wired into the vmapped ensemble "
+            "core; lane-loop fallback",
+        )
+    ok, why = vmap_capability(
+        path, k=k, interpret=interpret, with_field=with_field
+    )
+    if not ok:
+        return _solve_lane_loop(
+            problem, lanes, dtype, scheme, path, k, compute_errors,
+            interpret, block_x,
+            f"vmap capability probe failed on path {path!r}: {why}",
+        )
+    if with_field:
+        lanes = fill_fields(problem, lanes)
+    batch = lanes
+    if pad_to is not None:
+        if pad_to < len(lanes):
+            raise ValueError(
+                f"pad_to={pad_to} < {len(lanes)} real lanes"
+            )
+        pad = [padding_lane()] * (pad_to - len(lanes))
+        batch = lanes + (fill_fields(problem, pad) if with_field else pad)
+    if solver is None:
+        solver = EnsembleSolver(
+            problem, len(batch), dtype=dtype, path=path, k=k,
+            compute_errors=compute_errors, interpret=interpret,
+            block_x=block_x, with_field=with_field,
+        )
+    outputs, init_s, solve_s = solver.run(batch)
+    return EnsembleResult(
+        problem=problem,
+        results=_lane_results(problem, outputs, lanes, init_s, solve_s),
+        path=path,
+        batched=True,
+        fallback_reason=None,
+        batch_size=len(batch),
+        n_lanes=len(lanes),
+        init_seconds=init_s,
+        solve_seconds=solve_s,
+        u_prev_batch=outputs[0],
+        u_cur_batch=outputs[1],
+    )

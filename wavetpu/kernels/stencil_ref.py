@@ -107,6 +107,46 @@ def make_c2tau2_field(
     return c2 * problem.tau**2
 
 
+C2_PRESET_NAMES = ("constant", "gaussian-lens", "two-layer")
+
+
+def make_preset_c2tau2_field(problem: Problem, name: str) -> np.ndarray:
+    """The named tau^2 c^2(x,y,z) presets - ONE source of truth shared by
+    the CLI (`--c2-field`) and the serving API (`c2_field`), so the same
+    preset name always means the same physics on both surfaces.
+
+    constant: c^2 = a^2 everywhere (collapses to a2tau2; pinned by
+    tests/test_variable_c.py).  gaussian-lens: a slow-speed lens dipping
+    to a^2/2 at the domain centre.  two-layer: a discontinuous interface
+    with the far z half running at DOUBLE c^2 (note: Courant-unstable at
+    configs whose constant-c C is already near the bound - the serving
+    watchdog tests rely on exactly that).
+    """
+    a2 = problem.a2
+
+    def _gaussian_lens(x, y, z):
+        s2 = 2.0 * (problem.Lx / 8.0) ** 2
+        r2 = (
+            (x - problem.Lx / 2) ** 2
+            + (y - problem.Ly / 2) ** 2
+            + (z - problem.Lz / 2) ** 2
+        )
+        return a2 * (1.0 - 0.5 * np.exp(-r2 / s2))
+
+    presets = {
+        "constant": lambda x, y, z: a2 * np.ones_like(x + y + z),
+        "gaussian-lens": _gaussian_lens,
+        "two-layer": lambda x, y, z: np.where(
+            z < problem.Lz / 2, a2, 2.0 * a2
+        ) + 0.0 * x + 0.0 * y,
+    }
+    if name not in presets:
+        raise ValueError(
+            f"c2 preset must be one of {sorted(presets)}, got {name!r}"
+        )
+    return make_c2tau2_field(problem, presets[name])
+
+
 def make_variable_c_step(c2tau2_field):
     """A solver step with spatially varying speed:
     u_next = 2u - u_prev + tau^2 c^2(x,y,z) lap(u).

@@ -58,6 +58,38 @@ def guarded_amax(array) -> float:
     return float(np.asarray(_guard_fn()(array)))
 
 
+_lane_guard = None
+
+
+def _lane_guard_fn():
+    """Jitted per-lane guarded-amax over a (B, ...) batch (lazy, as
+    `_guard_fn`)."""
+    global _lane_guard
+    if _lane_guard is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def g(u):
+            x = jnp.abs(u).astype(jnp.float32)
+            x = jnp.where(jnp.isfinite(x), x, jnp.inf)
+            return jnp.max(x.reshape((x.shape[0], -1)), axis=1)
+
+        _lane_guard = g
+    return _lane_guard
+
+
+def guarded_amax_per_lane(array):
+    """Per-lane guarded amax over a leading batch axis: ONE fused device
+    pass, B scalars to host (numpy (B,) float array).  The ensemble
+    engine's per-batch watchdog (wavetpu/serve/engine.py) - same
+    semantics as `guarded_amax` applied lane by lane, without B separate
+    reductions."""
+    import numpy as np
+
+    return np.asarray(_lane_guard_fn()(array), dtype=np.float64)
+
+
 def state_amax(arrays: Iterable) -> float:
     """The guarded amax over a state tuple (None entries skipped - e.g.
     the carry-less increment form's missing Kahan carry)."""

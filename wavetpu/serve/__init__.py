@@ -1,0 +1,24 @@
+"""Inference-style serving layer over the ensemble engine.
+
+`engine.py` caches compiled batched programs (LRU, keyed by the full
+program identity incl. the batch-size bucket) and applies the per-lane
+numerical-health watchdog; `scheduler.py` coalesces concurrent requests
+into batches (shape bucketing + max-batch/max-wait dynamic batching);
+`api.py` is the stdlib-HTTP JSON front end (`wavetpu serve` /
+`wavetpu-serve`).  See docs/serving.md for the endpoint contract.
+"""
+
+from wavetpu.serve.engine import ProgramKey, ServeEngine
+from wavetpu.serve.scheduler import (
+    DynamicBatcher,
+    ServeMetrics,
+    SolveRequest,
+)
+
+__all__ = [
+    "DynamicBatcher",
+    "ProgramKey",
+    "ServeEngine",
+    "ServeMetrics",
+    "SolveRequest",
+]

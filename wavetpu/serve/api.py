@@ -1,0 +1,419 @@
+"""stdlib-HTTP JSON front end: `wavetpu serve` / `wavetpu-serve`.
+
+Endpoints (contract in docs/serving.md):
+
+  POST /solve    one solve request -> its own reference-format report.
+                 Body: {"N": 32, "timesteps": 20, ...} (fields below).
+                 Concurrent requests with the same program identity are
+                 coalesced into one batched XLA solve (scheduler.py);
+                 each response carries its lane's report plus batch
+                 context (occupancy, batched-or-fallback, path).
+  GET /healthz   liveness: {"status": "ok", ...}.
+  GET /metrics   request counts, batch occupancy, p50/p95 latency,
+                 aggregate Gcell/s, program-cache and fallback state.
+
+Request fields: N (required), Np, Lx, Ly, Lz (floats or "pi"), T,
+timesteps, phase (initial time phase, default 2*pi), steps (stop layer,
+default timesteps), scheme (standard|compensated), kernel
+(auto|roll|pallas), fuse_steps (K >= 2 selects the k-fused onion),
+dtype (f32|f64|bf16), c2_field (preset constant|gaussian-lens|two-layer).
+
+A request whose lane trips the numerical-health watchdog (NaN/Inf or
+amplitude blowup - e.g. a Courant-unstable config) gets HTTP 422 with the
+per-lane error; its batchmates' 200s are unaffected (engine.py).
+
+The server is stdlib-only (http.server.ThreadingHTTPServer): handler
+threads block on the batcher future while the single scheduler worker
+runs the XLA program - the same thread discipline as any Python
+inference server in front of an accelerator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Sequence, Tuple
+
+from wavetpu.core.problem import Problem, parse_length
+
+_USAGE = (
+    "usage: wavetpu serve [--host H] [--port P] [--max-batch B] "
+    "[--max-wait-ms MS] [--bucket-sizes 1,2,4,8] [--max-programs M] "
+    "[--kernel auto|roll|pallas] [--no-errors] [--max-amp X] "
+    "[--no-watchdog] [--warmup N,TIMESTEPS[,K]] [--platform NAME] "
+    "[--version]"
+)
+
+_KNOWN = (
+    "host", "port", "max-batch", "max-wait-ms", "bucket-sizes",
+    "max-programs", "kernel", "no-errors", "max-amp", "no-watchdog",
+    "warmup", "platform", "version",
+)
+_VALUELESS = ("no-errors", "no-watchdog", "version")
+
+
+def _split_flags(argv: Sequence[str]) -> dict:
+    flags = {}
+    it = iter(argv)
+    for a in it:
+        if not a.startswith("--"):
+            raise ValueError(f"unexpected positional {a!r}")
+        if "=" in a:
+            k, v = a[2:].split("=", 1)
+        else:
+            k = a[2:]
+            if k in _VALUELESS:
+                v = ""
+            else:
+                v = next(it, None)
+                if v is None:
+                    raise ValueError(f"flag --{k} needs a value")
+        if k not in _KNOWN:
+            raise ValueError(f"unknown flag --{k}")
+        flags[k] = v
+    return flags
+
+
+def _c2_preset(problem: Problem, spec: str):
+    """The CLI's --c2-field presets - one shared table
+    (stencil_ref.make_preset_c2tau2_field), so a preset name means the
+    same physics on both surfaces."""
+    from wavetpu.kernels import stencil_ref
+
+    if spec not in stencil_ref.C2_PRESET_NAMES:
+        raise ValueError(
+            f"c2_field must be one of "
+            f"{sorted(stencil_ref.C2_PRESET_NAMES)}, got {spec!r}"
+        )
+    return stencil_ref.make_preset_c2tau2_field(problem, spec)
+
+
+def parse_solve_request(body: dict, default_kernel: str = "auto"):
+    """Validate a POST /solve body into a SolveRequest (ValueError on any
+    bad field - mapped to HTTP 400)."""
+    from wavetpu.ensemble.batched import LaneSpec
+    from wavetpu.serve.scheduler import SolveRequest
+
+    if not isinstance(body, dict):
+        raise ValueError("request body must be a JSON object")
+    if "N" not in body:
+        raise ValueError("missing required field N")
+    problem = Problem(
+        N=int(body["N"]),
+        Np=int(body.get("Np", 1)),
+        Lx=parse_length(body.get("Lx", 1.0)),
+        Ly=parse_length(body.get("Ly", 1.0)),
+        Lz=parse_length(body.get("Lz", 1.0)),
+        T=float(body.get("T", 1.0)),
+        timesteps=int(body.get("timesteps", 20)),
+    )
+    scheme = body.get("scheme", "standard")
+    if scheme not in ("standard", "compensated"):
+        raise ValueError(
+            f"scheme must be standard|compensated, got {scheme!r}"
+        )
+    dtype_name = body.get("dtype", "f32")
+    if dtype_name not in ("f32", "f64", "bf16"):
+        raise ValueError(f"dtype must be f32|f64|bf16, got {dtype_name!r}")
+    kernel = body.get("kernel", default_kernel)
+    if kernel not in ("auto", "roll", "pallas"):
+        raise ValueError(
+            f"kernel must be auto|roll|pallas, got {kernel!r}"
+        )
+    fuse_steps = int(body.get("fuse_steps", 1))
+    if fuse_steps < 1:
+        raise ValueError(f"fuse_steps must be >= 1, got {fuse_steps}")
+    if kernel == "auto":
+        import jax
+
+        from wavetpu.cli import resolve_kernel
+
+        kernel = resolve_kernel("auto", jax.default_backend())
+    if fuse_steps > 1:
+        if kernel == "roll":
+            raise ValueError("fuse_steps needs the pallas kernel")
+        path = "kfused"
+    else:
+        path = kernel
+    stop = body.get("steps")
+    stop = None if stop is None else int(stop)
+    field = None
+    if body.get("c2_field"):
+        field = _c2_preset(problem, str(body["c2_field"]))
+    phase = float(body.get("phase", 2.0 * 3.141592653589793))
+    if scheme == "compensated":
+        # The compensated lane loop serves the reference phase and
+        # constant speed only (ensemble/batched.py); reject here so the
+        # client gets a 400, not a batch-time 500.
+        if "phase" in body:
+            raise ValueError(
+                "scheme=compensated serves the reference phase only"
+            )
+        if field is not None:
+            raise ValueError(
+                "scheme=compensated does not serve c2_field requests"
+            )
+    lane = LaneSpec(phase=phase, stop_step=stop, c2tau2_field=field)
+    # Surface lane-level errors (bad stop/k alignment) at parse time so
+    # they 400 instead of failing the whole batch later.
+    from wavetpu.ensemble.batched import _validate
+
+    _validate(problem, [lane], path, fuse_steps if path == "kfused" else 2,
+              compute_errors=False)
+    return SolveRequest(
+        problem=problem, lane=lane, scheme=scheme, path=path,
+        k=fuse_steps if path == "kfused" else 1, dtype_name=dtype_name,
+    )
+
+
+def _ok_payload(result, batch_info: dict, errors_computed: bool) -> dict:
+    """The reference report fields for one lane (io/report.py sidecar
+    contract) plus the verbatim text report."""
+    from wavetpu.io import report
+
+    p = result.problem
+    return {
+        "status": "ok",
+        "report": {
+            "problem": dataclasses.asdict(p),
+            "courant": p.courant,
+            "init_seconds": result.init_seconds,
+            "solve_seconds": result.solve_seconds,
+            "gcells_per_second": result.gcells_per_second,
+            "cells_per_step": p.cells_per_step,
+            "final_step": result.final_step,
+            "errors_computed": errors_computed,
+            "max_abs_error": (
+                float(result.abs_errors.max()) if errors_computed else None
+            ),
+            "abs_errors": (
+                [float(x) for x in result.abs_errors]
+                if errors_computed else None
+            ),
+            "rel_errors": (
+                [float(x) for x in result.rel_errors]
+                if errors_computed else None
+            ),
+        },
+        "report_text": report.format_report(
+            result, errors_computed=errors_computed
+        ),
+        "batch": batch_info,
+    }
+
+
+class ServerState:
+    """Everything the handler needs, hung off the HTTPServer instance."""
+
+    def __init__(self, engine, batcher, metrics, default_kernel: str,
+                 request_timeout: float = 600.0):
+        self.engine = engine
+        self.batcher = batcher
+        self.metrics = metrics
+        self.default_kernel = default_kernel
+        self.request_timeout = request_timeout
+        self.started = time.time()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # quiet by default; the scheduler's numbers live in /metrics
+    def log_message(self, fmt, *args):  # noqa: D102
+        pass
+
+    @property
+    def state(self) -> ServerState:
+        return self.server.wavetpu_state
+
+    def _send(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib contract)
+        if self.path == "/healthz":
+            self._send(200, {
+                "status": "ok",
+                "uptime_seconds": round(
+                    time.time() - self.state.started, 3
+                ),
+            })
+        elif self.path == "/metrics":
+            snap = self.state.metrics.snapshot()
+            snap["program_cache"] = self.state.engine.cache_stats()
+            self._send(200, snap)
+        else:
+            self._send(404, {"status": "error", "error": "not found"})
+
+    def do_POST(self) -> None:  # noqa: N802
+        if self.path != "/solve":
+            self._send(404, {"status": "error", "error": "not found"})
+            return
+        st = self.state
+        t0 = time.monotonic()
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            req = parse_solve_request(body, st.default_kernel)
+        except (ValueError, TypeError, json.JSONDecodeError) as e:
+            st.metrics.observe_response(False)
+            self._send(400, {"status": "error", "error": str(e)})
+            return
+        try:
+            fut = st.batcher.submit(req)
+            lane_result, lane_error, batch_info = fut.result(
+                st.request_timeout
+            )
+        except Exception as e:
+            st.metrics.observe_response(False)
+            self._send(500, {"status": "error", "error": str(e)})
+            return
+        finally:
+            st.metrics.observe_latency(time.monotonic() - t0)
+        if lane_error is not None:
+            st.metrics.observe_response(False)
+            self._send(422, {
+                "status": "error",
+                "error": lane_error,
+                "batch": batch_info,
+            })
+            return
+        errors_computed = (
+            st.engine.compute_errors and req.lane.c2tau2_field is None
+        )
+        st.metrics.observe_response(True)
+        self._send(200, _ok_payload(lane_result, batch_info,
+                                    errors_computed))
+
+
+def build_server(
+    host: str = "127.0.0.1",
+    port: int = 0,
+    bucket_sizes: Sequence[int] = (1, 2, 4, 8),
+    max_batch: Optional[int] = None,
+    max_wait: float = 0.025,
+    max_programs: int = 8,
+    compute_errors: bool = True,
+    watchdog: bool = True,
+    max_amp: Optional[float] = None,
+    default_kernel: str = "auto",
+    interpret: Optional[bool] = None,
+) -> Tuple[ThreadingHTTPServer, ServerState]:
+    """Assemble engine + batcher + HTTP server (port 0 = ephemeral; the
+    bound port is `httpd.server_address[1]`).  Returned httpd is not yet
+    serving - call `serve_forever()` (main does) or drive it from a
+    thread (tests do)."""
+    from wavetpu.serve.engine import ServeEngine
+    from wavetpu.serve.scheduler import DynamicBatcher, ServeMetrics
+
+    engine = ServeEngine(
+        bucket_sizes=bucket_sizes, max_programs=max_programs,
+        compute_errors=compute_errors, interpret=interpret,
+        watchdog=watchdog, max_amp=max_amp,
+    )
+    metrics = ServeMetrics()
+    batcher = DynamicBatcher(
+        engine, metrics=metrics, max_batch=max_batch, max_wait=max_wait
+    )
+    httpd = ThreadingHTTPServer((host, port), _Handler)
+    httpd.wavetpu_state = ServerState(
+        engine, batcher, metrics, default_kernel
+    )
+    return httpd, httpd.wavetpu_state
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    try:
+        flags = _split_flags(argv)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        print(_USAGE, file=sys.stderr)
+        return 2
+    if "version" in flags:
+        from wavetpu import __version__
+
+        print(f"wavetpu-serve {__version__}")
+        return 0
+    try:
+        host = flags.get("host", "127.0.0.1")
+        port = int(flags.get("port", "8077"))
+        buckets = tuple(
+            int(x) for x in flags.get("bucket-sizes", "1,2,4,8").split(",")
+        )
+        max_batch = (
+            int(flags["max-batch"]) if "max-batch" in flags else None
+        )
+        max_wait = float(flags.get("max-wait-ms", "25")) / 1e3
+        max_programs = int(flags.get("max-programs", "8"))
+        max_amp = float(flags["max-amp"]) if "max-amp" in flags else None
+        kernel = flags.get("kernel", "auto")
+        if kernel not in ("auto", "roll", "pallas"):
+            raise ValueError(
+                f"--kernel must be auto|roll|pallas, got {kernel}"
+            )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        print(_USAGE, file=sys.stderr)
+        return 2
+
+    import os
+
+    import jax
+
+    platform = flags.get("platform") or os.environ.get("JAX_PLATFORMS")
+    if platform and platform != jax.config.jax_platforms:
+        jax.config.update("jax_platforms", platform)
+
+    httpd, state = build_server(
+        host=host, port=port, bucket_sizes=buckets, max_batch=max_batch,
+        max_wait=max_wait, max_programs=max_programs,
+        compute_errors="no-errors" not in flags,
+        watchdog="no-watchdog" not in flags, max_amp=max_amp,
+        default_kernel=kernel,
+    )
+    if "warmup" in flags:
+        parts = [int(x) for x in flags["warmup"].split(",")]
+        if len(parts) not in (2, 3):
+            print("error: --warmup wants N,TIMESTEPS[,K]", file=sys.stderr)
+            return 2
+        wp = Problem(N=parts[0], timesteps=parts[1])
+        k = parts[2] if len(parts) == 3 else 1
+        path = "kfused" if k > 1 else (
+            "pallas" if jax.default_backend() == "tpu" else "roll"
+        )
+        warmed = state.engine.warmup(wp, path=path, k=max(k, 2))
+        print(f"warmed buckets {warmed} for N={wp.N} path={path}")
+
+    bound = httpd.server_address
+    print(
+        f"wavetpu serve on http://{bound[0]}:{bound[1]} "
+        f"(backend={jax.default_backend()}, max_batch="
+        f"{state.batcher.max_batch}, max_wait="
+        f"{state.batcher.max_wait * 1e3:g}ms, buckets="
+        f"{state.engine.bucket_sizes})"
+    )
+    import signal
+
+    def _shutdown(signum, frame):
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _shutdown)
+    signal.signal(signal.SIGINT, _shutdown)
+    try:
+        httpd.serve_forever()
+    finally:
+        state.batcher.close()
+        httpd.server_close()
+    print("wavetpu serve: shut down cleanly")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

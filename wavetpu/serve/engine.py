@@ -1,0 +1,276 @@
+"""Compiled-program cache + batched execution for the serve layer.
+
+One compiled batched program serves every request that matches its
+identity: `ProgramKey` = the full problem geometry (N, Lx/y/z, T,
+timesteps), scheme, kernel path, k, dtype, whether lanes carry c2 fields,
+whether errors are computed, and the BATCH-SIZE BUCKET.  Requests are
+padded up to the nearest bucket with masked `padding_lane()`s (which
+provably leave real lanes bitwise unchanged - tests/test_ensemble.py), so
+a handful of buckets (default 1/2/4/8) covers every occupancy without
+per-batch recompilation.
+
+The cache is a plain LRU: `max_programs` compiled executables, eviction
+of the least-recently-used on overflow, hits/misses/evictions counted for
+/metrics.  `warmup()` AOT-compiles ahead of traffic so the first request
+of a bucket does not pay the XLA compile.
+
+Every batch passes the per-lane numerical-health watchdog (the same
+guarded-amax reduction as run/health.py): a poisoned lane - NaN, Inf, or
+amplitude blowup from e.g. a Courant-unstable request - yields a per-lane
+error string while its batchmates' results stand.  One bad request can
+not sink the batch.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+from wavetpu.core.problem import Problem
+from wavetpu.ensemble import batched as ensemble
+from wavetpu.run import health
+
+
+class ProgramKey(NamedTuple):
+    """Identity of one compiled batched program (the cache key)."""
+
+    N: int
+    Lx: float
+    Ly: float
+    Lz: float
+    T: float
+    timesteps: int
+    scheme: str
+    path: str
+    k: int
+    dtype: str
+    with_field: bool
+    compute_errors: bool
+    batch: int
+
+    @classmethod
+    def for_batch(cls, problem: Problem, scheme: str, path: str, k: int,
+                  dtype_name: str, with_field: bool, compute_errors: bool,
+                  batch: int) -> "ProgramKey":
+        return cls(
+            N=problem.N, Lx=problem.Lx, Ly=problem.Ly, Lz=problem.Lz,
+            T=problem.T, timesteps=problem.timesteps, scheme=scheme,
+            path=path, k=k if path == "kfused" else 1, dtype=dtype_name,
+            with_field=with_field, compute_errors=compute_errors,
+            batch=batch,
+        )
+
+
+class ServeEngine:
+    """LRU-cached batched programs + watchdogged batch execution.
+
+    Thread-safe for the single-scheduler-worker design (a lock guards the
+    cache anyway so warmup from another thread is safe).  `interpret`
+    defaults to auto (interpret-mode pallas off-TPU, native on TPU).
+    """
+
+    def __init__(
+        self,
+        bucket_sizes: Sequence[int] = (1, 2, 4, 8),
+        max_programs: int = 8,
+        compute_errors: bool = True,
+        interpret: Optional[bool] = None,
+        watchdog: bool = True,
+        max_amp: Optional[float] = None,
+        block_x: Optional[int] = None,
+    ):
+        if not bucket_sizes or any(b < 1 for b in bucket_sizes):
+            raise ValueError(f"bad bucket_sizes {bucket_sizes}")
+        if max_programs < 1:
+            raise ValueError(f"max_programs must be >= 1, got {max_programs}")
+        self.bucket_sizes = tuple(sorted(set(int(b) for b in bucket_sizes)))
+        self.max_programs = max_programs
+        self.compute_errors = compute_errors
+        self.interpret = interpret
+        self.watchdog = watchdog
+        self.max_amp = max_amp
+        self.block_x = block_x
+        self._lock = threading.Lock()
+        self._programs: "OrderedDict[ProgramKey, ensemble.EnsembleSolver]" = (
+            OrderedDict()
+        )
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        # path -> recorded fallback reason (never silent; surfaced in
+        # /metrics so an operator sees WHICH path refused to vmap).
+        self.fallbacks: dict = {}
+
+    @property
+    def max_batch(self) -> int:
+        return self.bucket_sizes[-1]
+
+    def bucket_for(self, n_lanes: int) -> int:
+        """Smallest bucket >= n_lanes (the scheduler never exceeds
+        max_batch, so there is always one)."""
+        for b in self.bucket_sizes:
+            if b >= n_lanes:
+                return b
+        raise ValueError(
+            f"{n_lanes} lanes exceed the largest bucket "
+            f"{self.bucket_sizes[-1]}"
+        )
+
+    def _dtype(self, dtype_name: str):
+        import jax.numpy as jnp
+
+        table = {"f32": jnp.float32, "f64": jnp.float64,
+                 "bf16": jnp.bfloat16}
+        if dtype_name not in table:
+            raise ValueError(
+                f"dtype must be one of {sorted(table)}, got {dtype_name!r}"
+            )
+        return table[dtype_name]
+
+    def program(
+        self, problem: Problem, scheme: str, path: str, k: int,
+        dtype_name: str, with_field: bool, batch: int,
+    ) -> Optional[ensemble.EnsembleSolver]:
+        """The cached compiled program for this key, building (and
+        compiling) on miss - or None when the vmapped core cannot serve
+        the key (compensated scheme, or a failed capability probe): the
+        caller then runs the recorded lane-loop fallback."""
+        compute_errors = self.compute_errors and not with_field
+        if scheme != "standard":
+            self.fallbacks.setdefault(
+                f"scheme:{scheme}",
+                "compensated scheme is not wired into the vmapped core",
+            )
+            return None
+        ok, why = ensemble.vmap_capability(
+            path, k=k, interpret=self.interpret, with_field=with_field
+        )
+        if not ok:
+            self.fallbacks.setdefault(f"path:{path}", why)
+            return None
+        key = ProgramKey.for_batch(
+            problem, scheme, path, k, dtype_name, with_field,
+            compute_errors, batch,
+        )
+        with self._lock:
+            prog = self._programs.get(key)
+            if prog is not None:
+                self._programs.move_to_end(key)
+                self.hits += 1
+                return prog
+            self.misses += 1
+        # Build + compile OUTSIDE the lock (XLA compiles can take
+        # seconds; warmup from another thread must not serialize on it).
+        prog = ensemble.EnsembleSolver(
+            problem, batch, dtype=self._dtype(dtype_name), path=path, k=k,
+            compute_errors=compute_errors, interpret=self.interpret,
+            block_x=self.block_x, with_field=with_field,
+        )
+        prog.compile()
+        with self._lock:
+            self._programs[key] = prog
+            self._programs.move_to_end(key)
+            while len(self._programs) > self.max_programs:
+                self._programs.popitem(last=False)
+                self.evictions += 1
+        return prog
+
+    def warmup(
+        self, problem: Problem, scheme: str = "standard",
+        path: str = "roll", k: int = 4, dtype_name: str = "f32",
+        with_field: bool = False, batches: Optional[Sequence[int]] = None,
+    ) -> List[int]:
+        """AOT-compile the key for each requested bucket (default: all);
+        returns the bucket sizes actually warmed (empty when the path
+        falls back - recorded, not raised)."""
+        warmed = []
+        for b in (self.bucket_sizes if batches is None else batches):
+            if self.program(
+                problem, scheme, path, k, dtype_name, with_field, b
+            ) is not None:
+                warmed.append(b)
+        return warmed
+
+    def cache_stats(self) -> dict:
+        with self._lock:
+            return {
+                "programs": len(self._programs),
+                "max_programs": self.max_programs,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "keys": [list(k) for k in self._programs],
+                "fallbacks": dict(self.fallbacks),
+            }
+
+    # ---- execution ----
+
+    def lane_health(
+        self, result: ensemble.EnsembleResult
+    ) -> List[Optional[str]]:
+        """Per-lane watchdog verdicts: None = healthy, else the error
+        string for that lane's response.  The guarded-amax reduction maps
+        NaN/Inf to +inf (run/health.py), so a poisoned lane trips without
+        touching its batchmates."""
+        if not self.watchdog:
+            return [None] * len(result.results)
+        # One fused pass per state array over the whole batch (B scalars
+        # to host), not B separate reductions.  The vmapped path hands
+        # us its raw batched outputs (no copy); the lane-loop fallback
+        # has separate per-lane arrays and pays one stack each.
+        if result.u_prev_batch is not None:
+            amaxes = [
+                health.guarded_amax_per_lane(batch)[: len(result.results)]
+                for batch in (result.u_prev_batch, result.u_cur_batch)
+            ]
+        else:
+            import jax.numpy as jnp
+
+            amaxes = [
+                health.guarded_amax_per_lane(
+                    jnp.stack([getattr(r, name) for r in result.results])
+                )
+                for name in ("u_prev", "u_cur")
+            ]
+        out = []
+        for amax in map(max, zip(*amaxes)):
+            amax = float(amax)
+            if health.healthy(amax, self.max_amp):
+                out.append(None)
+            else:
+                bound = (
+                    health.DEFAULT_AMP_BOUND
+                    if self.max_amp is None else self.max_amp
+                )
+                out.append(
+                    f"numerical-health trip: guarded amax {amax:g} "
+                    f"exceeds bound {bound:g} (NaN/Inf count as inf)"
+                )
+        return out
+
+    def solve(
+        self, problem: Problem, lanes: Sequence[ensemble.LaneSpec],
+        scheme: str = "standard", path: str = "roll", k: int = 4,
+        dtype_name: str = "f32",
+    ) -> Tuple[ensemble.EnsembleResult, List[Optional[str]]]:
+        """Pad to the bucket, run the cached program (or the recorded
+        fallback), watchdog each lane; returns (EnsembleResult,
+        per-lane health)."""
+        lanes = list(lanes)
+        with_field = any(lane.c2tau2_field is not None for lane in lanes)
+        compute_errors = self.compute_errors and not with_field
+        bucket = self.bucket_for(len(lanes))
+        prog = self.program(
+            problem, scheme, path, k, dtype_name, with_field, bucket
+        )
+        result = ensemble.solve_ensemble(
+            problem, lanes, dtype=self._dtype(dtype_name), scheme=scheme,
+            path=path, k=k, compute_errors=compute_errors,
+            interpret=self.interpret, block_x=self.block_x,
+            pad_to=bucket if prog is not None else None,
+            solver=prog,
+        )
+        if not result.batched and result.fallback_reason:
+            self.fallbacks.setdefault(f"path:{path}", result.fallback_reason)
+        return result, self.lane_health(result)

@@ -1,0 +1,296 @@
+"""Dynamic batching: coalesce concurrent solve requests into ensembles.
+
+The request path is the standard inference-serving shape (arXiv:2108.11076
+batches simulations the same way an LLM server batches prompts):
+
+ * `submit()` enqueues a `SolveRequest` and returns a future immediately
+   (the HTTP handler thread blocks on it; the server stays concurrent).
+ * One worker thread drains the queue.  Requests are SHAPE-BUCKETED by
+   `SolveRequest.bucket_key()` - everything the compiled program identity
+   depends on (problem geometry, scheme, kernel path, k, dtype, field
+   presence) - because only same-key requests can share a program.
+ * A batch closes when it reaches `max_batch` lanes or `max_wait` seconds
+   after its first request - the classic max-batch/max-wait tradeoff
+   (batch occupancy vs tail latency).  Non-matching requests seen while
+   collecting are stashed and served next round in arrival order.
+ * The engine pads the batch to its bucket with masked lanes, runs the
+   cached program, watchdogs each lane; every future resolves with ITS
+   lane's result (or per-lane health error) plus batch context.
+
+`ServeMetrics` is the shared counter block /metrics renders: request and
+batch counts, occupancy, latency percentiles over a sliding reservoir,
+and aggregate Gcell/s across all served lanes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import List, Optional, Tuple
+
+from wavetpu.core.problem import Problem
+from wavetpu.ensemble.batched import LaneSpec
+
+
+@dataclasses.dataclass
+class SolveRequest:
+    """One lane's worth of work plus its program identity."""
+
+    problem: Problem
+    lane: LaneSpec
+    scheme: str = "standard"
+    path: str = "roll"
+    k: int = 1
+    dtype_name: str = "f32"
+
+    def bucket_key(self) -> Tuple:
+        """Everything the compiled program identity depends on; only
+        same-key requests may share a batch."""
+        p = self.problem
+        return (
+            p.N, p.Lx, p.Ly, p.Lz, p.T, p.timesteps,
+            self.scheme, self.path,
+            self.k if self.path == "kfused" else 1,
+            self.dtype_name,
+            self.lane.c2tau2_field is not None,
+        )
+
+
+class ServeMetrics:
+    """Thread-safe counters for /metrics (shared by scheduler + api)."""
+
+    def __init__(self, latency_window: int = 1024):
+        self._lock = threading.Lock()
+        self.started = time.time()
+        self.requests_total = 0
+        self.responses_ok = 0
+        self.responses_error = 0
+        self.batches_total = 0
+        self.occupancy_sum = 0
+        self.occupancy_max = 0
+        self.fallback_batches = 0
+        self.cells_total = 0.0
+        self.solve_seconds_total = 0.0
+        self._latencies = deque(maxlen=latency_window)
+
+    def observe_request(self) -> None:
+        with self._lock:
+            self.requests_total += 1
+
+    def observe_response(self, ok: bool) -> None:
+        with self._lock:
+            if ok:
+                self.responses_ok += 1
+            else:
+                self.responses_error += 1
+
+    def observe_batch(self, occupancy: int, batched: bool,
+                      cells: float, solve_seconds: float) -> None:
+        with self._lock:
+            self.batches_total += 1
+            self.occupancy_sum += occupancy
+            self.occupancy_max = max(self.occupancy_max, occupancy)
+            if not batched:
+                self.fallback_batches += 1
+            self.cells_total += cells
+            self.solve_seconds_total += solve_seconds
+
+    def observe_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._latencies.append(seconds)
+
+    def _percentile(self, p: float) -> Optional[float]:
+        if not self._latencies:
+            return None
+        xs = sorted(self._latencies)
+        idx = min(len(xs) - 1, int(round(p * (len(xs) - 1))))
+        return xs[idx]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            mean_occ = (
+                self.occupancy_sum / self.batches_total
+                if self.batches_total else None
+            )
+            p50 = self._percentile(0.50)
+            p95 = self._percentile(0.95)
+            agg = (
+                self.cells_total / self.solve_seconds_total / 1e9
+                if self.solve_seconds_total else None
+            )
+            return {
+                "uptime_seconds": round(time.time() - self.started, 3),
+                "requests_total": self.requests_total,
+                "responses_ok": self.responses_ok,
+                "responses_error": self.responses_error,
+                "batches_total": self.batches_total,
+                "batch_occupancy_mean": mean_occ,
+                "batch_occupancy_max": self.occupancy_max,
+                "fallback_batches": self.fallback_batches,
+                "latency_p50_ms": None if p50 is None else round(
+                    p50 * 1e3, 3
+                ),
+                "latency_p95_ms": None if p95 is None else round(
+                    p95 * 1e3, 3
+                ),
+                "aggregate_gcells_per_s": (
+                    None if agg is None else round(agg, 4)
+                ),
+            }
+
+
+@dataclasses.dataclass
+class _Item:
+    request: SolveRequest
+    future: Future
+    key: Tuple
+
+
+class DynamicBatcher:
+    """The request queue + single batching worker.
+
+    `max_wait` bounds how long the FIRST request of a batch waits for
+    company; `max_batch` (usually the engine's largest bucket) bounds the
+    batch.  `submit()` is safe from any thread (futures are
+    `concurrent.futures.Future`); `close()` joins the worker, then fails
+    every still-unresolved future - both the worker's stash and anything
+    left in (or racing into) the queue - with a RuntimeError.
+    """
+
+    def __init__(self, engine, metrics: Optional[ServeMetrics] = None,
+                 max_batch: Optional[int] = None, max_wait: float = 0.025):
+        self.engine = engine
+        self.metrics = metrics if metrics is not None else ServeMetrics()
+        self.max_batch = (
+            engine.max_batch if max_batch is None
+            else min(max_batch, engine.max_batch)
+        )
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        self.max_wait = max_wait
+        self._q: "queue.Queue[_Item]" = queue.Queue()
+        self._pending: "deque[_Item]" = deque()
+        self._closed = False
+        self._worker = threading.Thread(
+            target=self._loop, name="wavetpu-batcher", daemon=True
+        )
+        self._worker.start()
+
+    def submit(self, request: SolveRequest) -> Future:
+        if self._closed:
+            raise RuntimeError("batcher is closed")
+        item = _Item(request, Future(), request.bucket_key())
+        self.metrics.observe_request()
+        self._q.put(item)
+        return item.future
+
+    def close(self, timeout: float = 5.0) -> None:
+        self._closed = True
+        self._q.put(None)  # wake the worker
+        self._worker.join(timeout)
+        # Fail EVERY unresolved future: the worker's stash plus anything
+        # still in the queue (including a submit that raced past the
+        # _closed check) - a blocked HTTP handler must get its 500, not
+        # sit out the full request timeout.
+        leftovers = list(self._pending)
+        self._pending.clear()
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                leftovers.append(item)
+        for item in leftovers:
+            if not item.future.done():
+                item.future.set_exception(
+                    RuntimeError("server shutting down")
+                )
+
+    # ---- worker ----
+
+    def _take_pending(self, key, limit: int) -> List[_Item]:
+        taken, keep = [], deque()
+        while self._pending:
+            item = self._pending.popleft()
+            if item.key == key and len(taken) < limit:
+                taken.append(item)
+            else:
+                keep.append(item)
+        self._pending = keep
+        return taken
+
+    def _loop(self) -> None:
+        while True:
+            if self._pending:
+                first = self._pending.popleft()
+            else:
+                item = self._q.get()
+                if item is None:
+                    if self._closed:
+                        return
+                    continue
+                first = item
+            batch = [first]
+            batch += self._take_pending(
+                first.key, self.max_batch - len(batch)
+            )
+            deadline = time.monotonic() + self.max_wait
+            while len(batch) < self.max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    if self._closed:
+                        self._execute(batch)
+                        return
+                    continue
+                if nxt.key == first.key:
+                    batch.append(nxt)
+                else:
+                    self._pending.append(nxt)
+            self._execute(batch)
+
+    def _execute(self, batch: List[_Item]) -> None:
+        req0 = batch[0].request
+        try:
+            result, lane_health = self.engine.solve(
+                req0.problem,
+                [item.request.lane for item in batch],
+                scheme=req0.scheme, path=req0.path, k=req0.k,
+                dtype_name=req0.dtype_name,
+            )
+        except Exception as e:
+            for item in batch:
+                item.future.set_exception(e)
+            return
+        cells = sum(
+            req0.problem.cells_per_step * (r.steps_computed or 0)
+            for r in result.results
+        )
+        self.metrics.observe_batch(
+            occupancy=result.n_lanes, batched=result.batched,
+            cells=cells, solve_seconds=result.solve_seconds,
+        )
+        batch_info = {
+            "occupancy": result.n_lanes,
+            "batch_size": result.batch_size,
+            "batched": result.batched,
+            "fallback_reason": result.fallback_reason,
+            "path": result.path,
+            "aggregate_gcells_per_s": round(
+                result.aggregate_gcells_per_second, 4
+            ),
+        }
+        for i, item in enumerate(batch):
+            item.future.set_result(
+                (result.results[i], lane_health[i], batch_info)
+            )

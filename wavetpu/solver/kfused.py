@@ -53,16 +53,17 @@ from wavetpu.solver import leapfrog
 from wavetpu.verify import oracle
 
 
-def _oracle_parts(problem: Problem, f_dtype):
+def _oracle_parts(problem: Problem, f_dtype, phase: float = oracle.TWO_PI):
     """Precomputed separable-oracle pieces for the in-kernel error path.
 
     syz / rsyz are the (N, N) planes sy*sz and 1/|sy*sz| (exact-zero cells
     -> 0: there u = f = 0 and the reference's NaN-skip reports 0,
     oracle.layer_errors).  inv_absx is the per-x-plane rescale 1/|sx| with
-    the x=0 interior exclusion and exact zeros folded in.
+    the x=0 interior exclusion and exact zeros folded in.  `phase` is the
+    analytic solution's time phase (per-lane in the ensemble engine).
     """
     sx, sy, sz = oracle.spatial_factors(problem, f_dtype)
-    ct = oracle.time_factor_table(problem, f_dtype)
+    ct = oracle.time_factor_table(problem, f_dtype, phase)
     syz = sy[:, None] * sz[None, :]
     rsyz = jnp.where(
         syz == 0, jnp.asarray(0, f_dtype),
@@ -104,7 +105,8 @@ def _block_errors(dmax, rmax, ctk, xmask, inv_absx):
 
 
 def _validate(problem: Problem, k: int, c2tau2_field=None,
-              compute_errors: bool = True):
+              compute_errors: bool = True,
+              phase: float = oracle.TWO_PI):
     if k < 2:
         raise ValueError(f"k must be >= 2 (got {k}); use leapfrog.solve "
                          "with the pallas step for k=1")
@@ -115,10 +117,17 @@ def _validate(problem: Problem, k: int, c2tau2_field=None,
             "variable-c runs have no analytic oracle; pass "
             "compute_errors=False with c2tau2_field"
         )
+    if c2tau2_field is not None and phase != oracle.TWO_PI:
+        raise ValueError(
+            "a shifted phase bootstraps layer 1 from the analytic "
+            "solution, which only exists for constant speed; use the "
+            "reference phase with c2tau2_field"
+        )
 
 
 def _make_march(problem, dtype, k, compute_errors, block_x, interpret,
-                nsteps, c2tau2_field=None, chunk_len=None):
+                nsteps, c2tau2_field=None, chunk_len=None,
+                phase: float = oracle.TWO_PI):
     """Shared march: k-fused blocks + a 1-step remainder tail.
 
     `make_kfused_solver`, `resume_kfused`, and `make_chunk_runner` MUST
@@ -140,8 +149,8 @@ def _make_march(problem, dtype, k, compute_errors, block_x, interpret,
     runtime argument, never an HLO literal.
     """
     f = stencil_ref.compute_dtype(dtype)
-    sx, ct, syz, rsyz, xmask, inv_absx = _oracle_parts(problem, f)
-    errors = leapfrog._error_fn(problem, dtype)
+    sx, ct, syz, rsyz, xmask, inv_absx = _oracle_parts(problem, f, phase)
+    errors = leapfrog._error_fn(problem, dtype, phase)
     # The field enters the jitted program as a RUNTIME argument (the
     # `*field_params` splat below: () constant-c, (field,) variable-c) -
     # closing over it would embed an N^3 HLO literal (leapfrog.ParamStep).
@@ -213,6 +222,7 @@ def make_kfused_solver(
     block_x: Optional[int] = None,
     interpret: bool = False,
     c2tau2_field=None,
+    phase: float = oracle.TWO_PI,
 ):
     """Build the jitted k-fused solver; returns `(runner, run_params)`
     where `run_params` is the runtime-argument tuple to call the runner
@@ -224,9 +234,11 @@ def make_kfused_solver(
     1-step kernel; then (nsteps-1)//k fused blocks; a remainder of
     (nsteps-1) % k layers runs the 1-step kernel (same ops, so the tail is
     seamless).  Requires k >= 2 and N % k == 0; a field requires
-    compute_errors=False (no analytic oracle).
+    compute_errors=False (no analytic oracle) and the reference phase
+    (a shifted phase needs the analytic layer-1 bootstrap, which does
+    not exist under variable c).
     """
-    _validate(problem, k, c2tau2_field, compute_errors)
+    _validate(problem, k, c2tau2_field, compute_errors, phase)
     nsteps = problem.timesteps if stop_step is None else stop_step
     if not 1 <= nsteps <= problem.timesteps:
         raise ValueError(
@@ -243,15 +255,22 @@ def make_kfused_solver(
         )
     march, step1_fn, errors = _make_march(
         problem, dtype, k, compute_errors, block_x, interpret, nsteps,
-        field_dev,
+        field_dev, phase=phase,
     )
 
     def run(*field_params):
-        u0 = leapfrog.initial_layer0(problem, dtype)
+        u0 = leapfrog.initial_layer0(problem, dtype, phase)
         params = field_params[0] if field_params else ()
-        u1 = (0.5 * (u0.astype(f)
-                     + step1_fn(u0, u0, problem, params).astype(f))
-              ).astype(dtype)
+        if phase != oracle.TWO_PI:
+            # Shifted phases have nonzero initial velocity, which the
+            # step-derived Taylor bootstrap cannot represent; layer 1 is
+            # the exact analytic initialization instead (statically
+            # absent at the reference phase - see leapfrog.make_solver).
+            u1 = leapfrog.analytic_layer(problem, dtype, phase, 1)
+        else:
+            u1 = (0.5 * (
+                u0.astype(f) + step1_fn(u0, u0, problem, params).astype(f)
+            )).astype(dtype)
         a0 = r0 = jnp.zeros((), f)
         if compute_errors:
             a1, r1 = errors(u1, 1)
@@ -275,6 +294,7 @@ def solve_kfused(
     block_x: Optional[int] = None,
     interpret: bool = False,
     c2tau2_field=None,
+    phase: float = oracle.TWO_PI,
 ) -> leapfrog.SolveResult:
     """Compile + run the k-fused solve (reference timing phases as
     `leapfrog.solve`).  `c2tau2_field` (host (N,N,N) tau^2 c^2 array,
@@ -282,7 +302,7 @@ def solve_kfused(
     it with compute_errors=False."""
     runner, run_params = make_kfused_solver(
         problem, dtype, k, compute_errors, stop_step, block_x, interpret,
-        c2tau2_field,
+        c2tau2_field, phase,
     )
     (u_prev, u_cur, abs_all, rel_all), init_s, solve_s = (
         leapfrog._timed_compile_run(

@@ -102,16 +102,17 @@ def _as_param_step(step_fn):
     return (lambda up, u, p, _, f=step_fn: f(up, u, p)), ()
 
 
-def _error_fn(problem: Problem, dtype):
+def _error_fn(problem: Problem, dtype, phase: float = oracle.TWO_PI):
     """Returns (u, n) -> (abs_e, rel_e) with precomputed factors closed over.
 
     The oracle always evaluates in the compute dtype (f32 for bf16 state):
     the error should measure the solver, not the bf16 quantization of the
-    analytic field.
+    analytic field.  `phase` is the initial time phase of the analytic
+    solution (default: the reference's 2*pi; per-lane in the ensemble).
     """
     f_dtype = stencil_ref.compute_dtype(dtype)
     sx, sy, sz = oracle.spatial_factors(problem, f_dtype)
-    ct_table = oracle.time_factor_table(problem, f_dtype)
+    ct_table = oracle.time_factor_table(problem, f_dtype, phase)
     mask = jnp.asarray(oracle.interior_masks_1d(problem.N))
 
     def errors(u, n):
@@ -121,17 +122,29 @@ def _error_fn(problem: Problem, dtype):
     return errors
 
 
-def initial_layer0(problem: Problem, dtype=jnp.float32) -> jax.Array:
-    """Layer 0: the analytic solution at t=0, Dirichlet re-imposed.
+def analytic_layer(
+    problem: Problem, dtype=jnp.float32, phase: float = oracle.TWO_PI,
+    n: int = 0,
+) -> jax.Array:
+    """The analytic solution at layer n, Dirichlet re-imposed.
 
-    Reference: the layer-0 fill of `calculate_start` (openmp_sol.cpp:126-133).
-    bf16 state evaluates in f32 and rounds once.
+    n=0 is the reference's layer-0 fill (`calculate_start`,
+    openmp_sol.cpp:126-133); n=1 is the EXACT two-level initialization a
+    phase-shifted lane bootstraps with (see make_solver).  bf16 state
+    evaluates in f32 and rounds once.
     """
     f = stencil_ref.compute_dtype(dtype)
     sx, sy, sz = oracle.spatial_factors(problem, f)
-    ct0 = oracle.time_factor(problem, 0, f)
-    u0 = oracle.analytic_field(sx, sy, sz, ct0)
-    return stencil_ref.apply_dirichlet(u0).astype(dtype)
+    ct = oracle.time_factor(problem, n, f, phase)
+    u = oracle.analytic_field(sx, sy, sz, ct)
+    return stencil_ref.apply_dirichlet(u).astype(dtype)
+
+
+def initial_layer0(
+    problem: Problem, dtype=jnp.float32, phase: float = oracle.TWO_PI
+) -> jax.Array:
+    """Layer 0: the analytic solution at t=0 (see `analytic_layer`)."""
+    return analytic_layer(problem, dtype, phase, 0)
 
 
 def initial_state(problem: Problem, dtype=jnp.float32) -> Tuple[jax.Array, jax.Array]:
@@ -233,6 +246,7 @@ def make_solver(
     step_fn: Optional[Callable] = None,
     compute_errors: bool = True,
     stop_step: Optional[int] = None,
+    phase: float = oracle.TWO_PI,
 ) -> Tuple[Callable, object]:
     """Build the jitted end-to-end solver.
 
@@ -254,9 +268,35 @@ def make_solver(
     `problem.timesteps`).  tau stays `T / timesteps` regardless, so a stopped
     run is the exact prefix of the full one - the state a checkpoint captures
     (io/checkpoint.py) and `resume` continues from.
+
+    `phase` sets the analytic initial condition's time phase (lane identity
+    in the ensemble engine); the default 2*pi reproduces the reference.
+    A shifted phase has NONZERO initial velocity u_t(0) = -a_t sin(phase)
+    * Sx Sy Sz, which the reference's velocity-less Taylor bootstrap
+    u1 = u0 + (C/2) lap(u0) cannot represent - using it anyway would
+    integrate a DIFFERENT initial-value problem than the oracle measures
+    and report O(1) "error".  Shifted-phase solves therefore bootstrap
+    layer 1 ANALYTICALLY (u1 = Sx Sy Sz cos(a_t tau + phase), the exact
+    two-level initialization), which the oracle is exact for; the
+    reference phase keeps the step-derived bootstrap, so the default
+    program is bit-identical to the phase-less solver.  (An explicit
+    tau * u_t(0) correction term was tried first: LLVM FMA-contracts
+    the add differently between the solo and vmapped program shapes on
+    XLA-CPU - even across optimization_barrier - breaking bitwise lane
+    parity; the analytic bootstrap sidesteps fusion entirely.)
     """
     step, step_params = _as_param_step(step_fn)
-    errors = _error_fn(problem, dtype)
+    errors = _error_fn(problem, dtype, phase)
+    analytic_bootstrap = phase != oracle.TWO_PI
+    if analytic_bootstrap and jax.tree_util.tree_leaves(step_params):
+        # Runtime step params mark a variable-c kernel (ParamStep); the
+        # analytic bootstrap would silently initialize from the
+        # constant-speed solution and solve a different IVP.
+        raise ValueError(
+            "a shifted phase bootstraps layer 1 from the analytic "
+            "solution, which only exists for constant speed; use the "
+            "reference phase with variable-c step functions"
+        )
     nsteps = problem.timesteps if stop_step is None else stop_step
     if not 1 <= nsteps <= problem.timesteps:
         raise ValueError(
@@ -264,11 +304,17 @@ def make_solver(
         )
 
     def run(step_params):
-        u0 = initial_layer0(problem, dtype)
+        u0 = initial_layer0(problem, dtype, phase)
         f = stencil_ref.compute_dtype(dtype)
-        u1 = (
-            0.5 * (u0.astype(f) + step(u0, u0, problem, step_params).astype(f))
-        ).astype(dtype)
+        if analytic_bootstrap:
+            u1 = analytic_layer(problem, dtype, phase, 1)
+        else:
+            u1 = (
+                0.5 * (
+                    u0.astype(f)
+                    + step(u0, u0, problem, step_params).astype(f)
+                )
+            ).astype(dtype)
         # Layer 0 is *assigned from* the oracle, so its error is zero by
         # definition; the reference reads back the memory it just wrote and
         # reports exactly 0 (openmp_sol.cpp:126-133, 169-190).  Recomputing
@@ -299,6 +345,7 @@ def solve(
     step_fn: Optional[Callable] = None,
     compute_errors: bool = True,
     stop_step: Optional[int] = None,
+    phase: float = oracle.TWO_PI,
 ) -> SolveResult:
     """Compile + run, with the reference's two timing phases.
 
@@ -307,7 +354,7 @@ def solve(
     execution wall time (mpi_new.cpp:472-474, 354-357).
     """
     runner, step_params = make_solver(
-        problem, dtype, step_fn, compute_errors, stop_step
+        problem, dtype, step_fn, compute_errors, stop_step, phase
     )
     (u_prev, u_cur, abs_all, rel_all), init_s, solve_s = _timed_compile_run(
         runner, (step_params,), sync=lambda out: np.asarray(out[2])

@@ -58,26 +58,39 @@ def spatial_factors(problem: Problem, dtype=jnp.float32):
     )
 
 
-def time_factor(problem: Problem, n: int, dtype=jnp.float32):
-    """cos(a_t * tau * n + 2*pi) for a *static* layer n, computed on host.
+def time_factor(problem: Problem, n: int, dtype=jnp.float32,
+                phase: float = TWO_PI):
+    """cos(a_t * tau * n + phase) for a *static* layer n, computed on host.
 
     Deliberately numpy, not jnp: XLA's device `cos` is a fast-math
     approximation (measured ~3e-8 absolute error for f64 on CPU), which would
     pollute the error oracle.  See `time_factor_table` for traced indices.
+
+    `phase` defaults to the reference's 2*pi; the ensemble engine
+    (wavetpu/ensemble) varies it per lane - the analytic solution solves
+    the PDE for ANY time phase, so the oracle stays exact.
     """
     return jnp.asarray(
-        np.cos(problem.a_t * problem.tau * float(n) + TWO_PI), dtype=dtype
+        np.cos(problem.a_t * problem.tau * float(n) + phase), dtype=dtype
     )
 
 
-def time_factor_table(problem: Problem, dtype=jnp.float32):
-    """cos(a_t*tau*n + 2*pi) for every layer n in [0, timesteps], exact f64 on
-    host, cast once.  Indexed by the traced step counter inside the scan -
+def time_factor_table(problem: Problem, dtype=jnp.float32,
+                      phase: float = TWO_PI):
+    """cos(a_t*tau*n + phase) for every layer n in [0, timesteps], exact f64
+    on host, cast once.  Indexed by the traced step counter inside the scan -
     removes all transcendentals from the device program."""
     n = np.arange(problem.timesteps + 1, dtype=np.float64)
     return jnp.asarray(
-        np.cos(problem.a_t * problem.tau * n + TWO_PI), dtype=dtype
+        np.cos(problem.a_t * problem.tau * n + phase), dtype=dtype
     )
+
+
+def time_factor_table_np(problem: Problem, phase: float = TWO_PI) -> np.ndarray:
+    """Host-f64 time-factor table (no device transfer) - the per-lane form
+    the ensemble engine stacks into its (B, timesteps+1) runtime argument."""
+    n = np.arange(problem.timesteps + 1, dtype=np.float64)
+    return np.cos(problem.a_t * problem.tau * n + phase)
 
 
 def analytic_field(sx, sy, sz, ct):
