@@ -16,7 +16,7 @@ import pytest
 from wavetpu.core.problem import Problem
 from wavetpu.ensemble import batched as eb
 from wavetpu.kernels import stencil_pallas, stencil_ref
-from wavetpu.solver import kfused, leapfrog
+from wavetpu.solver import kfused, kfused_comp, leapfrog
 
 
 def _bitwise(a, b):
@@ -216,39 +216,112 @@ class TestFields:
             )
 
 
-class TestFallbacks:
-    def test_compensated_lane_loop_recorded_and_exact(self, problem):
-        res = eb.solve_ensemble(
-            problem, [eb.LaneSpec(), eb.LaneSpec()],
-            scheme="compensated", path="pallas", interpret=True,
-        )
-        assert res.batched is False
-        assert "compensated" in res.fallback_reason
-        solo = leapfrog.solve_compensated(
-            problem,
-            comp_step_fn=stencil_pallas.make_compensated_step_fn(
-                interpret=True
-            ),
-        )
-        for r in res.results:
-            assert _bitwise(r.u_cur, solo.u_cur)
+class TestCompensatedLaneParity:
+    """The tentpole contract: flagship compensated (Kahan) lanes batch
+    through the vmapped core BITWISE equal to their solo compensated
+    solves - state, error vectors, shifted phases, early stops, padded
+    batches - on all three paths.  `solve_ensemble` must never report a
+    compensated fallback on a backend where the path vmaps."""
 
-    def test_compensated_kfused_lane_loop_is_the_velocity_onion(
-        self, problem
+    def test_roll(self, problem, lanes):
+        res = eb.solve_ensemble(
+            problem, lanes, scheme="compensated", path="roll"
+        )
+        solos = [
+            leapfrog.solve_compensated(
+                problem, phase=lane.phase, stop_step=lane.stop(problem)
+            )
+            for lane in lanes
+        ]
+        _assert_lane_parity(res, solos)
+
+    def test_pallas(self, problem, lanes):
+        res = eb.solve_ensemble(
+            problem, lanes, scheme="compensated", path="pallas",
+            interpret=True,
+        )
+        solos = [
+            leapfrog.solve_compensated(
+                problem,
+                comp_step_fn=stencil_pallas.make_compensated_step_fn(
+                    interpret=True
+                ),
+                phase=lane.phase, stop_step=lane.stop(problem),
+            )
+            for lane in lanes
+        ]
+        _assert_lane_parity(res, solos)
+
+    def test_kfused_velocity_onion(self, problem, lanes):
+        res = eb.solve_ensemble(
+            problem, lanes, scheme="compensated", path="kfused", k=2,
+            interpret=True,
+        )
+        solos = [
+            kfused_comp.solve_kfused_comp(
+                problem, k=2, interpret=True, phase=lane.phase,
+                stop_step=lane.stop(problem),
+            )
+            for lane in lanes
+        ]
+        _assert_lane_parity(res, solos)
+
+    def test_kfused_remainder_tail(self, lanes):
+        # (10 - 1) % 2 == 1: the batch runs the masked k=1 tail through
+        # the SAME velocity-form kernel the solo march does.
+        p10 = Problem(N=16, timesteps=10)
+        res = eb.solve_ensemble(
+            p10, lanes, scheme="compensated", path="kfused", k=2,
+            interpret=True,
+        )
+        solos = [
+            kfused_comp.solve_kfused_comp(
+                p10, k=2, interpret=True, phase=lane.phase,
+                stop_step=lane.stop(p10),
+            )
+            for lane in lanes
+        ]
+        _assert_lane_parity(res, solos)
+
+    def test_masked_padding_leaves_real_lanes_bitwise_unchanged(
+        self, problem, lanes
     ):
-        # A compensated + fuse_steps request must be served by the
-        # flagship velocity-form onion, not silently downgraded to the
-        # 1-step compensated scheme.
-        from wavetpu.solver import kfused_comp
-
-        res = eb.solve_ensemble(
-            problem, [eb.LaneSpec()], scheme="compensated",
-            path="kfused", k=2, interpret=True,
+        plain = eb.solve_ensemble(
+            problem, lanes, scheme="compensated", path="kfused", k=2,
+            interpret=True,
         )
-        assert res.batched is False
-        solo = kfused_comp.solve_kfused_comp(problem, k=2, interpret=True)
-        assert _bitwise(res.results[0].u_cur, solo.u_cur)
+        padded = eb.solve_ensemble(
+            problem, lanes, scheme="compensated", path="kfused", k=2,
+            interpret=True, pad_to=8,
+        )
+        assert padded.batch_size == 8 and padded.n_lanes == 3
+        for a, b in zip(padded.results, plain.results):
+            assert _bitwise(a.u_cur, b.u_cur)
+            assert _bitwise(a.u_prev, b.u_prev)
+            assert np.array_equal(a.abs_errors, b.abs_errors)
+            assert np.array_equal(a.rel_errors, b.rel_errors)
 
+    def test_no_compensated_fallback_on_vmapping_backends(self, problem):
+        # Acceptance pin: fallback_reason must not mention the
+        # compensated scheme on any path that vmaps on this backend.
+        for path, k in (("roll", 1), ("pallas", 1), ("kfused", 2)):
+            res = eb.solve_ensemble(
+                problem, [eb.LaneSpec()], scheme="compensated",
+                path=path, k=k, interpret=True,
+            )
+            assert res.batched, (path, res.fallback_reason)
+            assert res.fallback_reason is None
+
+    def test_compensated_field_batch_rejected(self, problem):
+        field = np.full((problem.N,) * 3, problem.a2tau2)
+        with pytest.raises(ValueError, match="compensated"):
+            eb.solve_ensemble(
+                problem, [eb.LaneSpec(c2tau2_field=field)],
+                scheme="compensated", path="roll", compute_errors=False,
+            )
+
+
+class TestFallbacks:
     def test_probe_failure_falls_back_with_reason(
         self, problem, lanes, monkeypatch
     ):
@@ -263,7 +336,26 @@ class TestFallbacks:
         solo = leapfrog.solve(problem, phase=1.0)
         assert _bitwise(res.results[1].u_cur, solo.u_cur)
 
-    def test_probe_verdict_is_cached(self):
+    def test_compensated_probe_failure_lane_loop_honors_phase(
+        self, problem, monkeypatch
+    ):
+        # The lane-loop fallback for the compensated scheme must pass
+        # each lane's phase through to the solo compensated solver.
+        monkeypatch.setattr(
+            eb, "vmap_capability",
+            lambda *a, **k: (False, "forced-by-test"),
+        )
+        res = eb.solve_ensemble(
+            problem, [eb.LaneSpec(phase=1.0)], scheme="compensated",
+            path="kfused", k=2, interpret=True,
+        )
+        assert res.batched is False
+        solo = kfused_comp.solve_kfused_comp(
+            problem, k=2, interpret=True, phase=1.0
+        )
+        assert _bitwise(res.results[0].u_cur, solo.u_cur)
+
+    def test_probe_verdict_is_cached_per_scheme(self):
         eb._PROBE_CACHE.clear()
         try:
             ok1, _ = eb.vmap_capability("roll", interpret=True)
@@ -271,6 +363,17 @@ class TestFallbacks:
             assert len(eb._PROBE_CACHE) == 1
             ok2, _ = eb.vmap_capability("roll", interpret=True)
             assert ok2 and len(eb._PROBE_CACHE) == 1
+            # the compensated scheme probes (and caches) separately
+            ok3, _ = eb.vmap_capability(
+                "roll", interpret=True, scheme="compensated"
+            )
+            assert ok3 and len(eb._PROBE_CACHE) == 2
+            probes = eb.probe_results()
+            assert len(probes) == 2
+            assert {p["scheme"] for p in probes} == {
+                "standard", "compensated"
+            }
+            assert all(p["ok"] for p in probes)
         finally:
             eb._PROBE_CACHE.clear()
 

@@ -80,15 +80,59 @@ class TestEngine:
         eng.solve(p, [eb.LaneSpec()], path="roll")
         assert eng.hits == 1  # served from the warmed program
 
-    def test_compensated_scheme_falls_back_recorded(self):
-        eng = ServeEngine(bucket_sizes=(1, 2), interpret=True)
-        p = Problem(N=8, timesteps=3)
-        res, health = eng.solve(p, [eb.LaneSpec()], scheme="compensated")
-        assert res.batched is False
-        assert "compensated" in res.fallback_reason
-        assert any(
-            k.startswith("scheme:") for k in eng.cache_stats()["fallbacks"]
+    def test_compensated_scheme_batches_through_the_engine(self):
+        # The flagship scheme now rides the vmapped core: padded to the
+        # bucket, no fallback, each lane bitwise its solo solve.
+        eng = ServeEngine(bucket_sizes=(1, 2, 4), interpret=True)
+        p = Problem(N=8, timesteps=5)
+        lanes = [eb.LaneSpec(), eb.LaneSpec(phase=1.0)]
+        res, health = eng.solve(
+            p, lanes, scheme="compensated", path="kfused", k=2
         )
+        assert res.batched is True
+        assert res.fallback_reason is None
+        assert res.batch_size == 2 and health == [None, None]
+        from wavetpu.solver import kfused_comp
+
+        solo = kfused_comp.solve_kfused_comp(
+            p, k=2, interpret=True, phase=1.0
+        )
+        assert _bitwise(res.results[1].u_cur, solo.u_cur)
+
+    def test_vmap_probes_surface_in_cache_stats(self):
+        eng = ServeEngine(bucket_sizes=(1,), interpret=True)
+        p = Problem(N=8, timesteps=3)
+        eng.solve(p, [eb.LaneSpec()], scheme="compensated", path="roll")
+        probes = eng.cache_stats()["vmap_probes"]
+        assert any(
+            pr.get("scheme") == "compensated" and pr["path"] == "roll"
+            and pr["ok"] for pr in probes
+        )
+        # every probe row names its backend and carries an ok/reason pair
+        for pr in probes:
+            assert "backend" in pr and "ok" in pr and "reason" in pr
+
+    def test_sharded_batched_program_cached_per_mesh_bucket(self):
+        eng = ServeEngine(bucket_sizes=(1, 2), interpret=True)
+        p = Problem(N=8, timesteps=4)
+        warmed = eng.warmup(p, path="roll", mesh=(2, 2, 1))
+        assert warmed == [1, 2]
+        res, health = eng.solve(
+            p, [eb.LaneSpec(), eb.LaneSpec(phase=1.0)], path="roll",
+            mesh=(2, 2, 1),
+        )
+        assert res.batched and res.fallback_reason is None
+        assert health == [None, None]
+        assert eng.hits == 1  # served from the warmed (mesh, bucket=2)
+        keys = eng.cache_stats()["keys"]
+        assert any(tuple(k[-1] or ()) == (2, 2, 1) for k in keys)
+        # parity of one lane vs the solo sharded solve
+        from wavetpu.solver import sharded
+
+        solo = sharded.solve_sharded(
+            p, mesh_shape=(2, 2, 1), kernel="roll", phase=1.0
+        )
+        assert _bitwise(res.results[1].u_cur, solo.u_cur)
 
     def test_watchdog_isolates_poisoned_lane(self):
         # C = 0.55: stable under constant c^2 = a^2, but the two-layer
@@ -124,6 +168,17 @@ class TestEngine:
         for i in range(3):
             assert out[i] == health.guarded_amax(batch[i])
 
+    def test_mesh_with_compensated_scheme_refused_loudly(self):
+        # Silently serving a compensated request with the standard
+        # scheme would be a wrong-result bug, not a fallback.
+        eng = ServeEngine(bucket_sizes=(1,), interpret=True)
+        p = Problem(N=8, timesteps=3)
+        with pytest.raises(ValueError, match="standard scheme only"):
+            eng.solve(
+                p, [eb.LaneSpec()], scheme="compensated", path="roll",
+                mesh=(2, 1, 1),
+            )
+
     def test_watchdog_can_be_disabled(self):
         p = Problem(N=8, T=26.0, timesteps=60)
         eng = ServeEngine(
@@ -147,7 +202,8 @@ class _FakeEngine:
         self.batches = []
         self.fail = fail
 
-    def solve(self, problem, lanes, scheme, path, k, dtype_name):
+    def solve(self, problem, lanes, scheme, path, k, dtype_name,
+              mesh=None):
         if self.fail:
             raise RuntimeError("engine exploded")
         self.batches.append(len(lanes))
@@ -224,6 +280,159 @@ class TestBatcher:
         assert base.bucket_key() != other.bucket_key()
         kf = SolveRequest(problem=p, lane=eb.LaneSpec(), path="kfused", k=2)
         assert base.bucket_key() != kf.bucket_key()
+        meshy = SolveRequest(
+            problem=p, lane=eb.LaneSpec(), mesh_shape=(2, 2, 1)
+        )
+        assert base.bucket_key() != meshy.bucket_key()
+
+
+class TestLengthBuckets:
+    """Length-bucketed scheduling: lanes with diverging stop_steps are
+    sorted into step-length buckets (k-block-granular) before batching,
+    so a short request never marches a long batch's masked tail."""
+
+    def _kreq(self, p, stop, k=2):
+        return SolveRequest(
+            problem=p, lane=eb.LaneSpec(stop_step=stop), path="kfused",
+            k=k,
+        )
+
+    def test_bucket_assignment_and_quantum(self):
+        p = Problem(N=8, timesteps=40)
+        b = DynamicBatcher(
+            _FakeEngine(), max_wait=0.01, length_bucket_steps=10
+        )
+        try:
+            # 1-step path: quantum 10, bucket = (stop-1)//10
+            assert b.length_bucket(_req(p)) == 3  # stop=40
+            r5 = SolveRequest(problem=p, lane=eb.LaneSpec(stop_step=5))
+            r11 = SolveRequest(problem=p, lane=eb.LaneSpec(stop_step=11))
+            assert b.length_bucket(r5) == 0
+            assert b.length_bucket(r11) == 1
+        finally:
+            b.close()
+
+    def test_quantum_rounds_up_to_k_block_grid(self):
+        # quantum 10 with k=4 aligns to 12: every bucket boundary sits
+        # on the onion's k-block grid ((stop-1) % k == 0 freeze points).
+        p = Problem(N=8, timesteps=40)
+        b = DynamicBatcher(
+            _FakeEngine(), max_wait=0.01, length_bucket_steps=10
+        )
+        try:
+            assert b.length_bucket(self._kreq(p, 13, k=4)) == 1  # 12//12
+            assert b.length_bucket(self._kreq(p, 12 + 1, k=4)) == 1
+            assert b.length_bucket(self._kreq(p, 9, k=4)) == 0
+            assert b.length_bucket(self._kreq(p, 25, k=4)) == 2
+        finally:
+            b.close()
+
+    def test_disabled_by_default_everything_one_bucket(self):
+        p = Problem(N=8, timesteps=40)
+        b = DynamicBatcher(_FakeEngine(), max_wait=0.01)
+        try:
+            r5 = SolveRequest(problem=p, lane=eb.LaneSpec(stop_step=5))
+            assert b.length_bucket(r5) == 0
+            assert b.length_bucket(_req(p)) == 0
+        finally:
+            b.close()
+
+    def test_different_length_buckets_never_share_a_batch(self):
+        eng = _FakeEngine()
+        b = DynamicBatcher(eng, max_wait=0.3, length_bucket_steps=10)
+        p = Problem(N=8, timesteps=40)
+        fs = b.submit(SolveRequest(problem=p, lane=eb.LaneSpec(stop_step=5)))
+        fl = b.submit(_req(p, phase=1.0))
+        fs2 = b.submit(SolveRequest(problem=p, lane=eb.LaneSpec(stop_step=7)))
+        out = [f.result(10) for f in (fs, fl, fs2)]
+        b.close()
+        # the two short requests coalesce; the long one runs alone
+        assert sorted(eng.batches) == [1, 2]
+        assert out[1][2]["occupancy"] == 1
+
+    def test_starvation_bound_stashed_request_served_next_round(self):
+        # A non-matching stashed request becomes the NEXT batch's leader
+        # (arrival order), so it waits at most one batch - the bound the
+        # occupancy/latency tradeoff rests on.
+        eng = _FakeEngine()
+        b = DynamicBatcher(eng, max_wait=0.2, length_bucket_steps=10)
+        p = Problem(N=8, timesteps=40)
+        f1 = b.submit(SolveRequest(problem=p, lane=eb.LaneSpec(stop_step=5)))
+        f2 = b.submit(_req(p, phase=1.0))  # different bucket: stashed
+        t0 = time.monotonic()
+        f1.result(10)
+        f2.result(10)
+        took = time.monotonic() - t0
+        b.close()
+        assert eng.batches == [1, 1]
+        assert took < 5.0
+
+
+class TestDrain:
+    def test_drain_resolves_queued_futures_with_results(self):
+        eng = _FakeEngine()
+        # max_wait far longer than the test: drain must flush
+        # immediately, not sit out the window.
+        b = DynamicBatcher(eng, max_wait=30.0, max_batch=2)
+        p = Problem(N=8, timesteps=3)
+        futs = [b.submit(_req(p, phase=1.0 + i)) for i in range(3)]
+        t0 = time.monotonic()
+        b.close(timeout=60.0, drain=True)
+        took = time.monotonic() - t0
+        for f in futs:
+            res, health, info = f.result(0)  # already resolved
+            assert health is None
+        assert took < 10.0
+        assert sum(eng.batches) == 3
+
+    def test_drain_refuses_new_submits(self):
+        b = DynamicBatcher(_FakeEngine(), max_wait=0.01)
+        b.close(drain=True)
+        p = Problem(N=8, timesteps=3)
+        with pytest.raises(RuntimeError, match="closed"):
+            b.submit(_req(p))
+
+    def test_drain_timeout_fails_unserved_futures_without_stranding(self):
+        # A drain that outlives its timeout must stop draining, and
+        # close() must fail whatever the worker could not finish -
+        # blocked handlers get an error, never the 600 s request
+        # timeout.  The slow engine makes each batch outlast the drain
+        # timeout deterministically.
+        class _SlowEngine(_FakeEngine):
+            def solve(self, *a, **k):
+                time.sleep(1.0)
+                return super().solve(*a, **k)
+
+        eng = _SlowEngine()
+        b = DynamicBatcher(eng, max_wait=30.0, max_batch=1)
+        p = Problem(N=8, timesteps=3)
+        futs = [b.submit(_req(p, phase=1.0 + i)) for i in range(4)]
+        b.close(timeout=0.2, drain=True)
+        resolved = errored = 0
+        for f in futs:
+            try:
+                f.result(10)  # in-flight batches may still land
+                resolved += 1
+            except RuntimeError:
+                errored += 1
+        assert resolved + errored == 4
+        assert errored >= 1  # the tail was failed, not stranded
+
+    def test_close_without_drain_still_errors_stashed_leftovers(self):
+        # The non-drain path keeps its contract: the in-flight batch
+        # resolves, but a stashed different-key request fails fast
+        # instead of hanging to the request timeout.
+        eng = _FakeEngine()
+        b = DynamicBatcher(eng, max_wait=30.0, max_batch=8)
+        pa = Problem(N=8, timesteps=3)
+        pb = Problem(N=8, timesteps=4)
+        f1 = b.submit(_req(pa))
+        f2 = b.submit(SolveRequest(problem=pb, lane=eb.LaneSpec()))
+        b.close(timeout=10.0)
+        res, health, info = f1.result(10)  # the batch in flight finishes
+        assert health is None
+        with pytest.raises(RuntimeError, match="shutting down"):
+            f2.result(0)
 
 
 # ---- request parsing ----
@@ -264,15 +473,46 @@ class TestParse:
             ({"N": 8, "dtype": "f16"}, "dtype"),
             ({"N": 8, "c2_field": "nope"}, "c2_field"),
             ({"N": 8, "steps": 99}, "stop_step"),
-            ({"N": 8, "scheme": "compensated", "phase": 1.0},
-             "reference phase"),
             ({"N": 8, "scheme": "compensated", "c2_field": "constant"},
              "c2_field"),
             ({"N": 8, "phase": 1.0, "c2_field": "constant"},
              "analytic layer-1"),
+            ({"N": 8, "mesh": [2, 2]}, "mesh"),
+            ({"N": 8, "mesh": [99, 99, 99]}, "devices"),
+            ({"N": 8, "mesh": [2, 1, 1], "scheme": "compensated"},
+             "standard scheme"),
+            ({"N": 8, "mesh": [2, 1, 1], "fuse_steps": 2,
+              "kernel": "pallas"}, "fuse_steps"),
+            ({"N": 8, "mesh": [2, 1, 1], "c2_field": "constant"},
+             "c2_field"),
         ]:
             with pytest.raises(ValueError, match=msg):
                 parse_solve_request(body, default_kernel="roll")
+
+    def test_compensated_bf16_rejected_at_parse(self):
+        with pytest.raises(ValueError, match="f32/f64"):
+            parse_solve_request(
+                {"N": 8, "scheme": "compensated", "dtype": "bf16"},
+                default_kernel="roll",
+            )
+
+    def test_compensated_shifted_phase_now_parses(self):
+        # The vmapped compensated core serves shifted phases; the old
+        # parse-time refusal is gone.
+        req = parse_solve_request(
+            {"N": 8, "scheme": "compensated", "phase": 1.0},
+            default_kernel="roll",
+        )
+        assert req.scheme == "compensated"
+        assert req.lane.phase == 1.0
+
+    def test_mesh_request_parses(self):
+        req = parse_solve_request(
+            {"N": 8, "mesh": [2, 2, 1], "phase": 1.0},
+            default_kernel="roll",
+        )
+        assert req.mesh_shape == (2, 2, 1)
+        assert req.path == "roll"
 
 
 # ---- HTTP end to end ----
@@ -352,6 +592,35 @@ class TestHTTP:
         assert code == 200
         assert body["status"] == "ok"
 
+    def test_draining_returns_503(self, server):
+        base, state = server
+        state.draining = True
+        try:
+            code, body = _post(base, {"N": 8, "timesteps": 4})
+            assert code == 503
+            assert "draining" in body["error"]
+        finally:
+            state.draining = False
+
+    def test_metrics_exposes_vmap_probes(self, server):
+        base, _ = server
+        _post(base, {"N": 8, "timesteps": 4})
+        code, metrics = _get(base, "/metrics")
+        assert code == 200
+        probes = metrics["program_cache"]["vmap_probes"]
+        assert any(p.get("path") == "roll" and p["ok"] for p in probes)
+
+    def test_mesh_request_serves_sharded_batched(self, server):
+        base, _ = server
+        code, body = _post(
+            base, {"N": 8, "timesteps": 4, "mesh": [2, 2, 1],
+                   "phase": 1.0}, timeout=300,
+        )
+        assert code == 200
+        assert body["batch"]["batched"] is True
+        assert "sharded(2, 2, 1)" in body["batch"]["path"]
+        assert body["report"]["final_step"] == 4
+
     def test_bad_request_400(self, server):
         base, _ = server
         code, body = _post(base, {"timesteps": 4})
@@ -422,3 +691,9 @@ class TestCLI:
         )
         assert key.k == 1  # non-kfused paths normalize k
         assert key.batch == 2
+        assert key.mesh is None  # single-device default
+        sharded_key = ProgramKey.for_batch(
+            p, "standard", "roll", 4, "f32", False, True, 2, (2, 2, 1)
+        )
+        assert sharded_key.mesh == (2, 2, 1)
+        assert sharded_key != key

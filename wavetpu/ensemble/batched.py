@@ -14,19 +14,26 @@ differs in
    field batches require compute_errors=False).
 
 Wired paths: "roll" (the jnp stencil), "pallas" (the fused 1-step slab
-kernel), "kfused" (the k-step onion, k >= 2).  Each lane's op sequence
-inside the vmapped program mirrors the corresponding solo solver's
-(leapfrog.make_solver / kfused.make_kfused_solver) op for op - the
-BITWISE lane-parity contract is pinned by tests/test_ensemble.py, and any
-change here or there must keep that suite green.
+kernel), "kfused" (the k-step onion, k >= 2) - each on BOTH schemes:
+"standard" mirrors leapfrog.make_solver / kfused.make_kfused_solver, and
+"compensated" (the flagship Kahan velocity form) mirrors
+leapfrog.make_compensated_solver / kfused_comp.make_kfused_comp_solver
+(the `fused_kstep_comp` onion for k >= 2).  Each lane's op sequence
+inside the vmapped program mirrors the corresponding solo solver's op
+for op - the BITWISE lane-parity contract is pinned by
+tests/test_ensemble.py, and any change here or there must keep that
+suite green.  Compensated batches are constant-speed only (the solo
+velocity-form field path exists, but per-lane fields are not wired
+through the compensated vmapped core).
 
-Not every path vmaps on every backend (Mosaic's batching support for the
-onion kernels differs from interpret mode's).  `vmap_capability` probes a
-tiny batched solve per (path, backend) once and caches the verdict; a
-failed probe - or the compensated scheme, which is not wired into the
-vmapped core - drops to the LANE-LOOP fallback (sequential solo solves
-behind the same EnsembleResult interface) with the reason RECORDED in
-`EnsembleResult.fallback_reason`.  Nothing falls back silently.
+Not every (scheme, path) vmaps on every backend (Mosaic's batching
+support for the onion kernels differs from interpret mode's).
+`vmap_capability` probes a tiny batched solve per (scheme, path,
+backend) once and caches the verdict; a failed probe drops to the
+LANE-LOOP fallback (sequential solo solves behind the same
+EnsembleResult interface) with the reason RECORDED in
+`EnsembleResult.fallback_reason`, and `probe_results()` exposes every
+cached verdict for GET /metrics.  Nothing falls back silently.
 
 Per-lane timestep masking on the "kfused" path freezes whole k-blocks, so
 a lane's stop_step must sit on the block grid ((stop-1) % k == 0) or be
@@ -45,6 +52,7 @@ from wavetpu.core.problem import Problem
 from wavetpu.verify import oracle
 
 PATHS = ("roll", "pallas", "kfused")
+SCHEMES = ("standard", "compensated")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -117,13 +125,25 @@ class EnsembleResult:
 
 
 def _validate(problem: Problem, lanes: Sequence[LaneSpec], path: str,
-              k: int, compute_errors: bool) -> bool:
+              k: int, compute_errors: bool,
+              scheme: str = "standard") -> bool:
     """Shared lane validation; returns with_field (all-or-none normalized
     by the caller via `fill_fields`)."""
     if path not in PATHS:
         raise ValueError(f"path must be one of {PATHS}, got {path!r}")
+    if scheme not in SCHEMES:
+        raise ValueError(
+            f"scheme must be one of {SCHEMES}, got {scheme!r}"
+        )
     if not lanes:
         raise ValueError("an ensemble needs at least one lane")
+    if scheme == "compensated" and any(
+        lane.c2tau2_field is not None for lane in lanes
+    ):
+        raise ValueError(
+            "per-lane c2tau2 fields are not wired through the compensated "
+            "vmapped core; use scheme='standard' for field batches"
+        )
     if path == "kfused":
         if k < 2:
             raise ValueError(f"kfused path needs k >= 2, got {k}")
@@ -209,6 +229,94 @@ def _lane_error_fn(problem: Problem, dtype):
     return errors
 
 
+def _lane_error_fn_guarded(problem: Problem, dtype):
+    """`_lane_error_fn` with the representation-zero sx planes excluded
+    from the REL metric - the runtime-ct-table twin of
+    kfused_comp._error_fn_guarded (the velocity-form onion's bootstrap-
+    layer metric).  Must stay op-for-op identical to it."""
+    import jax.numpy as jnp
+
+    from wavetpu.kernels import stencil_ref
+    from wavetpu.solver import kfused_comp
+
+    f_dtype = stencil_ref.compute_dtype(dtype)
+    sx, sy, sz = oracle.spatial_factors(problem, f_dtype)
+    mask = jnp.asarray(oracle.interior_masks_1d(problem.N))
+    mask_x = mask & (jnp.abs(sx) > kfused_comp._rel_guard_tol(f_dtype))
+
+    def errors(u, n, ct_table):
+        fld = oracle.analytic_field(sx, sy, sz, ct_table[n])
+        return oracle.layer_errors(
+            u.astype(f_dtype), fld, mask_x, mask, mask
+        )
+
+    return errors
+
+
+def _comp_bootstrap(problem: Problem, dtype, v_dtype, carry_dtype, sx, sy,
+                    sz, ct_table, taylor, comp_step):
+    """Compensated layers 0/1 from a runtime ct table.
+
+    The per-lane `taylor` selector mirrors the solo compensated solvers'
+    STATIC phase decision: True = the compensated half-step bootstrap
+    (v = carry = 0, coeff = C/2 - leapfrog.make_compensated_solver /
+    kfused_comp._bootstrap), False = the exact analytic two-level
+    initialization shifted phases take (u0/u1 analytic, v1 the exact
+    analytic increment Sx Sy Sz (ct1 - ct0) - a pure product, matching
+    leapfrog.analytic_increment_layer1; the u1 - u0 form FMA-contracts
+    differently between program shapes).  Both branches mirror the
+    corresponding solo program op for op; `where` selects bitwise.
+    """
+    import jax.numpy as jnp
+
+    from wavetpu.kernels import stencil_ref
+
+    u0 = stencil_ref.apply_dirichlet(
+        oracle.analytic_field(sx, sy, sz, ct_table[0])
+    ).astype(dtype)
+    zero = jnp.zeros_like(u0)
+    u1_s, v1_s, c1_s = comp_step(
+        u0, zero, zero, problem, 0.5 * problem.a2tau2
+    )
+    v1_s = v1_s.astype(v_dtype)
+    c1_s = c1_s.astype(carry_dtype)
+    u1_a = stencil_ref.apply_dirichlet(
+        oracle.analytic_field(sx, sy, sz, ct_table[1])
+    ).astype(dtype)
+    v1_a = stencil_ref.apply_dirichlet(
+        oracle.analytic_field(sx, sy, sz, ct_table[1] - ct_table[0])
+    ).astype(v_dtype)
+    c1_a = jnp.zeros(u0.shape, carry_dtype)
+    return (
+        jnp.where(taylor, u1_s, u1_a),
+        jnp.where(taylor, v1_s, v1_a),
+        jnp.where(taylor, c1_s, c1_a),
+    )
+
+
+def _comp_step1(path: str, block_x, interpret):
+    """The batch's 1-step compensated kernel
+    `(u, v, carry, problem, coeff) -> (u', v', carry')`: the jnp-roll
+    reference on the "roll" path, the fused Pallas kernel elsewhere
+    (the "kfused" lane bootstraps through the same Pallas 1-step kernel
+    the solo velocity-form onion does)."""
+    from wavetpu.kernels import stencil_pallas, stencil_ref
+
+    if path == "roll":
+        return stencil_ref.compensated_step
+    if path == "pallas":
+        return stencil_pallas.make_compensated_step_fn(
+            block_x=block_x, interpret=interpret
+        )
+
+    def step(u, v, carry, problem, coeff):
+        return stencil_pallas.compensated_step(
+            u, v, carry, problem, coeff, interpret=interpret
+        )
+
+    return step
+
+
 def _bootstrap(problem: Problem, dtype, sx, sy, sz, ct_table, taylor,
                step, params):
     """Layers 0/1 from a runtime ct table.
@@ -289,6 +397,7 @@ class EnsembleSolver:
         interpret: Optional[bool] = None,
         block_x: Optional[int] = None,
         with_field: bool = False,
+        scheme: str = "standard",
     ):
         import jax
         import jax.numpy as jnp
@@ -299,6 +408,10 @@ class EnsembleSolver:
             raise ValueError(f"n_lanes must be >= 1, got {n_lanes}")
         if path not in PATHS:
             raise ValueError(f"path must be one of {PATHS}, got {path!r}")
+        if scheme not in SCHEMES:
+            raise ValueError(
+                f"scheme must be one of {SCHEMES}, got {scheme!r}"
+            )
         if path == "kfused":
             if k < 2:
                 raise ValueError(f"kfused path needs k >= 2, got {k}")
@@ -318,14 +431,32 @@ class EnsembleSolver:
         self.k = k if path == "kfused" else 1
         self.compute_errors = compute_errors
         self.with_field = with_field
+        self.scheme = scheme
+        if scheme == "compensated":
+            if with_field:
+                raise ValueError(
+                    "per-lane c2tau2 fields are not wired through the "
+                    "compensated vmapped core"
+                )
+            if jnp.dtype(self.dtype) == jnp.bfloat16:
+                raise ValueError(
+                    "compensated scheme requires f32/f64 state"
+                )
         self._f = stencil_ref.compute_dtype(self.dtype)
         self._exec = None
         self.compile_seconds: Optional[float] = None
-        lane_run = (
-            self._kfused_lane(interpret, block_x)
-            if path == "kfused"
-            else self._onestep_lane(interpret, block_x)
-        )
+        if scheme == "compensated":
+            lane_run = (
+                self._comp_kfused_lane(interpret, block_x)
+                if path == "kfused"
+                else self._comp_onestep_lane(interpret, block_x)
+            )
+        else:
+            lane_run = (
+                self._kfused_lane(interpret, block_x)
+                if path == "kfused"
+                else self._onestep_lane(interpret, block_x)
+            )
         in_axes = (0, 0, 0, 0) if with_field else (0, 0, 0)
         self._runner = jax.jit(jax.vmap(lane_run, in_axes=in_axes))
 
@@ -485,6 +616,165 @@ class EnsembleSolver:
 
         return lane_run
 
+    def _comp_onestep_lane(self, interpret, block_x):
+        """Compensated (Kahan) 1-step lane: mirrors
+        leapfrog.make_compensated_solver op for op with a runtime ct
+        table (roll = stencil_ref.compensated_step, pallas = the fused
+        Pallas compensated kernel)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        problem, dtype, f = self.problem, self.dtype, self._f
+        compute_errors = self.compute_errors
+        sx, sy, sz = oracle.spatial_factors(problem, f)
+        errors = _lane_error_fn(problem, dtype)
+        step = _comp_step1(self.path, block_x, interpret)
+
+        def lane_run(ct_table, stop, taylor):
+            u1, v1, c1 = _comp_bootstrap(
+                problem, dtype, dtype, dtype, sx, sy, sz, ct_table,
+                taylor, step,
+            )
+            a0 = r0 = jnp.zeros((), f)
+            if compute_errors:
+                a1, r1 = errors(u1, 1, ct_table)
+            else:
+                a1 = r1 = jnp.zeros((), f)
+
+            def body(carry, n):
+                u, v, c = carry
+                u2, v2, c2 = step(u, v, c, problem, None)
+                live = n <= stop
+                if compute_errors:
+                    ae, re = errors(u2, n, ct_table)
+                    ae = jnp.where(live, ae, jnp.zeros((), f))
+                    re = jnp.where(live, re, jnp.zeros((), f))
+                else:
+                    ae = re = jnp.zeros((), f)
+                return (
+                    jnp.where(live, u2, u),
+                    jnp.where(live, v2, v),
+                    jnp.where(live, c2, c),
+                ), (ae, re)
+
+            (u, v, c), (abs_t, rel_t) = lax.scan(
+                body, (u1, v1, c1), jnp.arange(2, problem.timesteps + 1)
+            )
+            # u_prev reconstructed from the increment, as the solo
+            # compensated solver returns it.
+            return (
+                u - v,
+                u,
+                jnp.concatenate([jnp.stack([a0, a1]), abs_t]),
+                jnp.concatenate([jnp.stack([r0, r1]), rel_t]),
+            )
+
+        return lane_run
+
+    def _comp_kfused_lane(self, interpret, block_x):
+        """Velocity-form compensated onion lane: mirrors
+        kfused_comp._make_march (k-fused blocks + a k=1 tail through the
+        SAME kernel) with a runtime ct table, per-lane k-block stop
+        masking on (u, v, carry), and the guarded rel metric."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        from wavetpu.kernels import stencil_pallas
+        from wavetpu.solver import kfused, kfused_comp
+
+        problem, dtype, f = self.problem, self.dtype, self._f
+        k, compute_errors = self.k, self.compute_errors
+        v_dtype = dtype
+        carry_dtype = kfused_comp._default_carry_dtype(dtype)
+        sx, _ct, syz, rsyz, xmask, inv_absx = kfused._oracle_parts(
+            problem, f
+        )
+        inv_absx = jnp.where(
+            jnp.abs(sx) > kfused_comp._rel_guard_tol(f), inv_absx,
+            jnp.asarray(0.0, f),
+        )
+        _, sy, sz = oracle.spatial_factors(problem, f)
+        errors1 = _lane_error_fn_guarded(problem, dtype)
+        step1 = _comp_step1("kfused", block_x, interpret)
+        nsteps = problem.timesteps
+        nblocks = (nsteps - 1) // k
+        rem = (nsteps - 1) - nblocks * k
+
+        def kblock(u, v, c, ct_table, nstart, kk, bxo):
+            ctk = lax.dynamic_slice(ct_table, (nstart + 1,), (kk,))
+            sxct = ctk[:, None] * sx[None, :]
+            u2, v2, c2, dmax, rmax = stencil_pallas.fused_kstep_comp(
+                u, v, c, syz, rsyz, sxct,
+                k=kk, coeff=problem.a2tau2, inv_h2=problem.inv_h2,
+                block_x=bxo, interpret=interpret,
+                with_errors=compute_errors,
+            )
+            if compute_errors:
+                abs_e, rel_e = kfused._block_errors(
+                    dmax, rmax, ctk, xmask, inv_absx
+                )
+            else:
+                abs_e = rel_e = jnp.zeros((kk,), f)
+            return u2, v2, c2, abs_e, rel_e
+
+        def lane_run(ct_table, stop, taylor):
+            u1, v1, c1 = _comp_bootstrap(
+                problem, dtype, v_dtype, carry_dtype, sx, sy, sz,
+                ct_table, taylor, step1,
+            )
+            a0 = r0 = jnp.zeros((), f)
+            if compute_errors:
+                a1, r1 = errors1(u1, 1, ct_table)
+            else:
+                a1 = r1 = jnp.zeros((), f)
+
+            def body(state, nstart):
+                u, v, c = state
+                u2, v2, c2, abs_e, rel_e = kblock(
+                    u, v, c, ct_table, nstart, k, block_x
+                )
+                live = nstart + k <= stop
+                return (
+                    jnp.where(live, u2, u),
+                    jnp.where(live, v2, v),
+                    jnp.where(live, c2, c),
+                ), (
+                    jnp.where(live, abs_e, jnp.zeros((k,), f)),
+                    jnp.where(live, rel_e, jnp.zeros((k,), f)),
+                )
+
+            starts = 1 + k * jnp.arange(nblocks)
+            (u, v, c), (abs_b, rel_b) = lax.scan(
+                body, (u1, v1, c1), starts
+            )
+            abs_parts = [abs_b.reshape(-1)]
+            rel_parts = [rel_b.reshape(-1)]
+            for t in range(rem):
+                # The solo march's remainder: the same kernel at k=1
+                # (kfused_comp._make_march), masked per layer here.
+                u2, v2, c2, a_1, r_1 = kblock(
+                    u, v, c, ct_table, nsteps - rem + t, 1, None
+                )
+                live = nsteps - rem + t + 1 <= stop
+                u = jnp.where(live, u2, u)
+                v = jnp.where(live, v2, v)
+                c = jnp.where(live, c2, c)
+                abs_parts.append(
+                    jnp.where(live, a_1, jnp.zeros((1,), f))
+                )
+                rel_parts.append(
+                    jnp.where(live, r_1, jnp.zeros((1,), f))
+                )
+            # u_prev as kfused_comp._as_result reconstructs it.
+            return (
+                (u.astype(f) - v.astype(f)).astype(dtype),
+                u,
+                jnp.concatenate([jnp.stack([a0, a1])] + abs_parts),
+                jnp.concatenate([jnp.stack([r0, r1])] + rel_parts),
+            )
+
+        return lane_run
+
     # ---- packing / compiling / running ----
 
     def pack(self, lanes: Sequence[LaneSpec]) -> Tuple:
@@ -602,11 +892,12 @@ def vmap_capability(
     k: int = 2,
     interpret: Optional[bool] = None,
     with_field: bool = False,
+    scheme: str = "standard",
 ) -> Tuple[bool, Optional[str]]:
-    """Does jax.vmap compose with this path's kernels on this backend?
+    """Does jax.vmap compose with this (scheme, path) on this backend?
 
     Runs a tiny batched solve (N=8, 2 lanes) end to end once per
-    (path, with_field, backend) and caches the verdict.  Returns
+    (scheme, path, with_field, backend) and caches the verdict.  Returns
     (ok, reason): reason is the exception summary on failure - the string
     `solve_ensemble` records in `EnsembleResult.fallback_reason` so a
     fallback is never silent.
@@ -615,7 +906,8 @@ def vmap_capability(
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    key = (path, bool(with_field), bool(interpret), jax.default_backend())
+    key = (scheme, path, bool(with_field), bool(interpret),
+           jax.default_backend())
     if key in _PROBE_CACHE:
         return _PROBE_CACHE[key]
     try:
@@ -626,7 +918,7 @@ def vmap_capability(
         solver = EnsembleSolver(
             tiny, len(lanes), path=path, k=min(k, 2) if path == "kfused"
             else k, compute_errors=not with_field, interpret=interpret,
-            with_field=with_field,
+            with_field=with_field, scheme=scheme,
         )
         out, _, _ = solver.run(lanes)
         np.asarray(out[1])
@@ -637,6 +929,20 @@ def vmap_capability(
     return verdict
 
 
+def probe_results() -> list:
+    """Every cached vmap-capability verdict, as dicts - the /metrics
+    surface that makes a chip silently serving lane-loop visible from
+    the outside (GET /metrics -> program_cache.vmap_probes)."""
+    return [
+        {
+            "scheme": k[0], "path": k[1], "with_field": k[2],
+            "interpret": k[3], "backend": k[4],
+            "ok": v[0], "reason": v[1],
+        }
+        for k, v in sorted(_PROBE_CACHE.items(), key=lambda kv: kv[0])
+    ]
+
+
 # ---- lane-loop fallback ----
 
 def _solve_lane_loop(
@@ -644,8 +950,7 @@ def _solve_lane_loop(
     block_x, reason,
 ):
     """Sequential solo solves behind the EnsembleResult interface - the
-    recorded fallback when vmap does not compose (or for the compensated
-    scheme, which the vmapped core does not wire)."""
+    recorded fallback when vmap does not compose on this backend."""
     from wavetpu.kernels import stencil_pallas, stencil_ref
     from wavetpu.solver import kfused, leapfrog
 
@@ -654,14 +959,13 @@ def _solve_lane_loop(
     for lane in lanes:
         s = lane.stop(problem)
         if scheme == "compensated" and path == "kfused":
-            # The flagship velocity-form onion; served sequentially until
-            # the vmapped core wires the compensated scheme (ROADMAP).
+            # The flagship velocity-form onion, lane by lane.
             from wavetpu.solver import kfused_comp
 
             res = kfused_comp.solve_kfused_comp(
                 problem, dtype=dtype, k=k,
                 compute_errors=compute_errors, stop_step=s,
-                interpret=interpret,
+                interpret=interpret, phase=lane.phase,
             )
         elif scheme == "compensated":
             comp_step = None
@@ -672,6 +976,7 @@ def _solve_lane_loop(
             res = leapfrog.solve_compensated(
                 problem, dtype=dtype, comp_step_fn=comp_step,
                 compute_errors=compute_errors, stop_step=s,
+                phase=lane.phase,
             )
         elif path == "kfused":
             res = kfused.solve_kfused(
@@ -743,37 +1048,21 @@ def solve_ensemble(
     import jax.numpy as jnp
 
     dtype = jnp.float32 if dtype is None else dtype
-    if scheme not in ("standard", "compensated"):
-        raise ValueError(
-            f"scheme must be standard|compensated, got {scheme!r}"
-        )
     lanes = list(lanes)
-    with_field = _validate(problem, lanes, path, k, compute_errors)
+    with_field = _validate(problem, lanes, path, k, compute_errors,
+                           scheme)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    if scheme == "compensated":
-        if with_field or any(
-            lane.phase != oracle.TWO_PI for lane in lanes
-        ):
-            raise ValueError(
-                "the compensated lane-loop supports the reference phase "
-                "and constant speed only (the vmapped core does not wire "
-                "the compensated scheme yet)"
-            )
-        return _solve_lane_loop(
-            problem, lanes, dtype, scheme, path, k, compute_errors,
-            interpret, block_x,
-            "compensated scheme is not wired into the vmapped ensemble "
-            "core; lane-loop fallback",
-        )
     ok, why = vmap_capability(
-        path, k=k, interpret=interpret, with_field=with_field
+        path, k=k, interpret=interpret, with_field=with_field,
+        scheme=scheme,
     )
     if not ok:
         return _solve_lane_loop(
             problem, lanes, dtype, scheme, path, k, compute_errors,
             interpret, block_x,
-            f"vmap capability probe failed on path {path!r}: {why}",
+            f"vmap capability probe failed on scheme {scheme!r} path "
+            f"{path!r}: {why}",
         )
     if with_field:
         lanes = fill_fields(problem, lanes)
@@ -789,7 +1078,7 @@ def solve_ensemble(
         solver = EnsembleSolver(
             problem, len(batch), dtype=dtype, path=path, k=k,
             compute_errors=compute_errors, interpret=interpret,
-            block_x=block_x, with_field=with_field,
+            block_x=block_x, with_field=with_field, scheme=scheme,
         )
     outputs, init_s, solve_s = solver.run(batch)
     return EnsembleResult(

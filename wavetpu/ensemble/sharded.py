@@ -1,0 +1,423 @@
+"""Sharded x batched: an ensemble axis composed with the device mesh.
+
+PR 3's vmapped core batches SINGLE-DEVICE solves; this module composes
+the lane axis with the (MX, MY, MZ) mesh axes so a multi-chip host can
+serve a batch of SHARDED solves as one program - the pod-scale
+throughput composition of arXiv:2108.11076 (batch axis x device mesh).
+
+Mechanism: shard_map-of-vmap.  The state rides as (B,) + topo.padded
+sharded P(None, "x", "y", "z") - lane-major over the batch axis, spatial
+axes on the mesh exactly as solver/sharded.py lays them out - and inside
+shard_map the per-lane local march (the SAME op sequence
+`sharded._local_solve_fns` runs: halo ppermutes, boundary masking,
+pmax'd error reductions) is vmapped over the lane axis.  Collectives
+batch under vmap (ppermute/pmax have batching rules), so every lane's
+per-shard ops mirror the solo sharded solve op for op - the BITWISE
+lane-parity contract of tests/test_ensemble_sharded.py, the sharded twin
+of ensemble/batched.py's.
+
+Lane identity is (phase, stop_step) - per-lane runtime (B, T+1) ct
+tables, the per-lane taylor/analytic bootstrap selector, and per-layer
+`where` stop masking (no k-block constraint: the sharded lane marches
+the 1-step kernel).  Per-lane c2tau2 fields are not wired (constant
+speed only); scheme is "standard" (the distributed velocity-form
+flagship still serves solo via solver/kfused_comp.py).
+
+`vmap_capability(mesh_shape, ...)` probes a tiny batched sharded solve
+once per (mesh, kernel, backend) and caches the verdict; a failed probe
+drops to the recorded lane-loop fallback (sequential solo sharded
+solves), reason in `EnsembleResult.fallback_reason` and visible in
+GET /metrics.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from wavetpu.core.problem import Problem
+from wavetpu.ensemble.batched import (
+    EnsembleResult,
+    LaneSpec,
+    _lane_results,
+    padding_lane,
+)
+from wavetpu.verify import oracle
+
+KERNELS = ("roll", "pallas")
+
+
+def _validate(problem: Problem, lanes: Sequence[LaneSpec], kernel: str,
+              compute_errors: bool) -> None:
+    if kernel not in KERNELS:
+        raise ValueError(
+            f"kernel must be one of {KERNELS}, got {kernel!r}"
+        )
+    if not lanes:
+        raise ValueError("an ensemble needs at least one lane")
+    for i, lane in enumerate(lanes):
+        if lane.c2tau2_field is not None:
+            raise ValueError(
+                f"lane {i}: per-lane c2tau2 fields are not wired through "
+                f"the sharded ensemble (constant speed only)"
+            )
+        s = lane.stop(problem)
+        if not 1 <= s <= problem.timesteps:
+            raise ValueError(
+                f"lane {i}: stop_step must be in [1, {problem.timesteps}],"
+                f" got {s}"
+            )
+
+
+class ShardedEnsembleSolver:
+    """One compiled shard_map-of-vmap program for (problem, mesh, batch).
+
+    The sharded twin of `batched.EnsembleSolver` - same
+    compile()/pack()/run() contract, so the serve engine's program cache
+    holds either interchangeably.  Lane programs mirror
+    `sharded.make_sharded_solver`'s local op sequence (kernel="roll" or
+    "pallas", serial exchange, standard scheme).
+    """
+
+    def __init__(
+        self,
+        problem: Problem,
+        n_lanes: int,
+        mesh_shape: Tuple[int, int, int],
+        dtype=None,
+        kernel: str = "roll",
+        compute_errors: bool = True,
+        interpret: Optional[bool] = None,
+        devices=None,
+    ):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from wavetpu import compat
+        from wavetpu.core.grid import AXIS_NAMES
+        from wavetpu.kernels import stencil_ref
+        from wavetpu.solver import sharded
+
+        if n_lanes < 1:
+            raise ValueError(f"n_lanes must be >= 1, got {n_lanes}")
+        if kernel not in KERNELS:
+            raise ValueError(
+                f"kernel must be one of {KERNELS}, got {kernel!r}"
+            )
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        self.problem = problem
+        self.n_lanes = n_lanes
+        self.mesh_shape = tuple(int(m) for m in mesh_shape)
+        self.dtype = jnp.float32 if dtype is None else dtype
+        self.kernel = kernel
+        self.compute_errors = compute_errors
+        self._f = stencil_ref.compute_dtype(self.dtype)
+        self._exec = None
+        self.compile_seconds: Optional[float] = None
+        topo, mesh = sharded._resolve_mesh(
+            problem, self.mesh_shape, devices
+        )
+        self.topo = topo
+        f = self._f
+        dtype_s = self.dtype
+        nsteps = problem.timesteps
+        (sx, sy, sz), bcs, mes, _ct = sharded._replicated_inputs(
+            problem, topo, dtype_s
+        )
+        step = sharded._make_local_step(
+            problem, topo, dtype_s, kernel, False, interpret
+        )
+        compute = compute_errors
+
+        def lane_body(ct, stop, taylor, sx, sy, sz, bcx, bcy, bcz,
+                      mex, mey, mez):
+            # Per-lane local march: the op sequence of
+            # sharded._local_solve_fns (errors_fn/bootstrap/scan_layers)
+            # with the ct table a runtime argument, both bootstrap
+            # branches computed and `where`-selected per lane, and
+            # per-layer stop masking.
+            def errors(u, layer):
+                if not compute:
+                    z = jnp.zeros((), f)
+                    return z, z
+                field = oracle.analytic_field(sx, sy, sz, ct[layer])
+                ae, re = oracle.layer_errors(
+                    u.astype(f), field, mex, mey, mez
+                )
+                return (
+                    lax.pmax(ae, AXIS_NAMES),
+                    lax.pmax(re, AXIS_NAMES),
+                )
+
+            bc = (
+                bcx[:, None, None] * bcy[None, :, None]
+                * bcz[None, None, :]
+            )
+            u0 = (
+                oracle.analytic_field(sx, sy, sz, ct[0]) * bc
+            ).astype(dtype_s)
+            s = step(u0, u0, bc, None)
+            u1_step = (0.5 * (u0.astype(f) + s.astype(f))).astype(dtype_s)
+            u1_an = (
+                oracle.analytic_field(sx, sy, sz, ct[1]) * bc
+            ).astype(dtype_s)
+            u1 = jnp.where(taylor, u1_step, u1_an)
+            a0 = r0 = jnp.zeros((), f)
+            a1, r1 = errors(u1, 1)
+
+            def body(carry, n):
+                u_prev, u = carry
+                u_next = step(u_prev, u, bc, None)
+                live = n <= stop
+                ae, re = errors(u_next, n)
+                ae = jnp.where(live, ae, jnp.zeros((), f))
+                re = jnp.where(live, re, jnp.zeros((), f))
+                return (
+                    jnp.where(live, u, u_prev),
+                    jnp.where(live, u_next, u),
+                ), (ae, re)
+
+            (u_prev, u_cur), (abs_t, rel_t) = lax.scan(
+                body, (u0, u1), jnp.arange(2, nsteps + 1)
+            )
+            return (
+                u_prev,
+                u_cur,
+                jnp.concatenate([jnp.stack([a0, a1]), abs_t]),
+                jnp.concatenate([jnp.stack([r0, r1]), rel_t]),
+            )
+
+        def local_batch(cts, stops, taylors, sx, sy, sz, bcx, bcy, bcz,
+                        mex, mey, mez):
+            return jax.vmap(
+                lane_body, in_axes=(0, 0, 0) + (None,) * 9
+            )(cts, stops, taylors, sx, sy, sz, bcx, bcy, bcz,
+              mex, mey, mez)
+
+        state_spec = P(None, *AXIS_NAMES)
+        sharded_fn = compat.shard_map(
+            local_batch,
+            mesh=mesh,
+            in_specs=(
+                P(), P(), P(),
+                P("x"), P("y"), P("z"),
+                P("x"), P("y"), P("z"),
+                P("x"), P("y"), P("z"),
+            ),
+            out_specs=(state_spec, state_spec, P(), P()),
+            check_vma=False,
+        )
+
+        def run(cts, stops, taylors):
+            return sharded_fn(cts, stops, taylors, sx, sy, sz, *bcs, *mes)
+
+        self._runner = jax.jit(run)
+
+    # ---- packing / compiling / running (EnsembleSolver contract) ----
+
+    def pack(self, lanes: Sequence[LaneSpec]) -> Tuple:
+        import jax.numpy as jnp
+
+        if len(lanes) != self.n_lanes:
+            raise ValueError(
+                f"batch has {len(lanes)} lanes; this program wants "
+                f"{self.n_lanes} (pad with padding_lane())"
+            )
+        cts = np.stack(
+            [
+                oracle.time_factor_table_np(self.problem, lane.phase)
+                for lane in lanes
+            ]
+        )
+        stops = np.asarray(
+            [lane.stop(self.problem) for lane in lanes], np.int32
+        )
+        taylor = np.asarray(
+            [lane.phase == oracle.TWO_PI for lane in lanes], bool
+        )
+        return (
+            jnp.asarray(cts, self._f),
+            jnp.asarray(stops),
+            jnp.asarray(taylor),
+        )
+
+    def _example_args(self) -> Tuple:
+        import jax.numpy as jnp
+
+        b, t = self.n_lanes, self.problem.timesteps
+        return (
+            jnp.zeros((b, t + 1), self._f),
+            jnp.ones((b,), jnp.int32),
+            jnp.ones((b,), bool),
+        )
+
+    def compile(self) -> float:
+        if self._exec is not None:
+            return 0.0
+        t0 = time.perf_counter()
+        self._exec = self._runner.lower(*self._example_args()).compile()
+        self.compile_seconds = time.perf_counter() - t0
+        return self.compile_seconds
+
+    def run(self, lanes: Sequence[LaneSpec]):
+        import jax
+
+        init_s = self.compile()
+        args = self.pack(lanes)
+        t0 = time.perf_counter()
+        out = self._exec(*args)
+        jax.block_until_ready(out)
+        np.asarray(out[2])  # readback proves execution (leapfrog sync)
+        solve_s = time.perf_counter() - t0
+        return out, init_s, solve_s
+
+
+# ---- capability probe ----
+
+_PROBE_CACHE = {}
+
+
+def vmap_capability(
+    mesh_shape: Tuple[int, int, int],
+    kernel: str = "roll",
+    interpret: Optional[bool] = None,
+) -> Tuple[bool, Optional[str]]:
+    """Does shard_map-of-vmap compose on this (mesh, kernel, backend)?
+
+    Runs a tiny batched sharded solve once per key and caches the
+    verdict; `probe_results()` surfaces every cached entry for
+    GET /metrics alongside the single-device probes."""
+    import jax
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    key = (tuple(mesh_shape), kernel, bool(interpret),
+           jax.default_backend())
+    if key in _PROBE_CACHE:
+        return _PROBE_CACHE[key]
+    try:
+        tiny = Problem(N=8, timesteps=4)
+        lanes = [LaneSpec(), LaneSpec(phase=1.0)]
+        solver = ShardedEnsembleSolver(
+            tiny, len(lanes), mesh_shape, kernel=kernel,
+            interpret=interpret,
+        )
+        out, _, _ = solver.run(lanes)
+        np.asarray(out[1])
+        verdict = (True, None)
+    except Exception as e:  # recorded, never raised
+        verdict = (False, f"{type(e).__name__}: {e}")
+    _PROBE_CACHE[key] = verdict
+    return verdict
+
+
+def probe_results() -> list:
+    """Cached sharded vmap-capability verdicts as dicts (for /metrics)."""
+    return [
+        {
+            "mesh": list(k[0]), "kernel": k[1], "interpret": k[2],
+            "backend": k[3], "ok": v[0], "reason": v[1],
+        }
+        for k, v in sorted(_PROBE_CACHE.items(), key=lambda kv: str(kv[0]))
+    ]
+
+
+# ---- lane-loop fallback + entry point ----
+
+def _solve_lane_loop(problem, lanes, mesh_shape, dtype, kernel,
+                     compute_errors, interpret, devices, reason):
+    """Sequential solo sharded solves behind the EnsembleResult
+    interface - the recorded fallback when the composition does not
+    vmap on this backend."""
+    from wavetpu.solver import sharded
+
+    results = []
+    init_total = solve_total = 0.0
+    for lane in lanes:
+        res = sharded.solve_sharded(
+            problem, mesh_shape=mesh_shape, devices=devices, dtype=dtype,
+            compute_errors=compute_errors, kernel=kernel,
+            interpret=interpret, stop_step=lane.stop(problem),
+            phase=lane.phase,
+        )
+        init_total += res.init_seconds
+        solve_total += res.solve_seconds
+        results.append(res)
+    return EnsembleResult(
+        problem=problem,
+        results=results,
+        path=f"sharded{tuple(mesh_shape)}:{kernel}",
+        batched=False,
+        fallback_reason=reason,
+        batch_size=len(lanes),
+        n_lanes=len(lanes),
+        init_seconds=init_total,
+        solve_seconds=solve_total,
+    )
+
+
+def solve_ensemble_sharded(
+    problem: Problem,
+    lanes: Sequence[LaneSpec],
+    mesh_shape: Tuple[int, int, int],
+    dtype=None,
+    kernel: str = "roll",
+    compute_errors: bool = True,
+    interpret: Optional[bool] = None,
+    devices=None,
+    pad_to: Optional[int] = None,
+    solver: Optional[ShardedEnsembleSolver] = None,
+) -> EnsembleResult:
+    """Solve a batch of lanes as ONE sharded batched program over
+    `mesh_shape` (or the recorded lane-loop fallback).  Same padding /
+    pre-built-solver contract as `batched.solve_ensemble`; every lane is
+    bitwise equal to its solo `sharded.solve_sharded` on the same mesh
+    (u_prev/u_cur are the PADDED topo arrays, as the solo solver
+    returns them)."""
+    import jax
+    import jax.numpy as jnp
+
+    dtype = jnp.float32 if dtype is None else dtype
+    lanes = list(lanes)
+    _validate(problem, lanes, kernel, compute_errors)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    ok, why = vmap_capability(mesh_shape, kernel=kernel,
+                              interpret=interpret)
+    if not ok:
+        return _solve_lane_loop(
+            problem, lanes, mesh_shape, dtype, kernel, compute_errors,
+            interpret, devices,
+            f"sharded vmap capability probe failed on mesh "
+            f"{tuple(mesh_shape)} kernel {kernel!r}: {why}",
+        )
+    batch = lanes
+    if pad_to is not None:
+        if pad_to < len(lanes):
+            raise ValueError(f"pad_to={pad_to} < {len(lanes)} real lanes")
+        batch = lanes + [padding_lane()] * (pad_to - len(lanes))
+    if solver is None:
+        solver = ShardedEnsembleSolver(
+            problem, len(batch), mesh_shape, dtype=dtype, kernel=kernel,
+            compute_errors=compute_errors, interpret=interpret,
+            devices=devices,
+        )
+    outputs, init_s, solve_s = solver.run(batch)
+    return EnsembleResult(
+        problem=problem,
+        results=_lane_results(problem, outputs, lanes, init_s, solve_s),
+        path=f"sharded{tuple(mesh_shape)}:{kernel}",
+        batched=True,
+        fallback_reason=None,
+        batch_size=len(batch),
+        n_lanes=len(lanes),
+        init_seconds=init_s,
+        solve_seconds=solve_s,
+        u_prev_batch=outputs[0],
+        u_cur_batch=outputs[1],
+    )

@@ -14,13 +14,19 @@ Endpoints (contract in docs/serving.md):
 
 Request fields: N (required), Np, Lx, Ly, Lz (floats or "pi"), T,
 timesteps, phase (initial time phase, default 2*pi), steps (stop layer,
-default timesteps), scheme (standard|compensated), kernel
+default timesteps), scheme (standard|compensated - BOTH batch through
+the vmapped core, incl. the flagship compensated velocity form), kernel
 (auto|roll|pallas), fuse_steps (K >= 2 selects the k-fused onion),
-dtype (f32|f64|bf16), c2_field (preset constant|gaussian-lens|two-layer).
+dtype (f32|f64|bf16), c2_field (preset constant|gaussian-lens|two-layer;
+standard scheme only), mesh ([MX, MY, MZ] - route through the sharded x
+batched composition over that device mesh; standard scheme, no
+fuse_steps/c2_field).
 
 A request whose lane trips the numerical-health watchdog (NaN/Inf or
 amplitude blowup - e.g. a Courant-unstable config) gets HTTP 422 with the
-per-lane error; its batchmates' 200s are unaffected (engine.py).
+per-lane error; its batchmates' 200s are unaffected (engine.py).  During
+a graceful drain (SIGTERM/SIGINT) new /solve requests get 503 while
+queued work flushes to completion.
 
 The server is stdlib-only (http.server.ThreadingHTTPServer): handler
 threads block on the batcher future while the single scheduler worker
@@ -43,15 +49,15 @@ from wavetpu.core.problem import Problem, parse_length
 _USAGE = (
     "usage: wavetpu serve [--host H] [--port P] [--max-batch B] "
     "[--max-wait-ms MS] [--bucket-sizes 1,2,4,8] [--max-programs M] "
-    "[--kernel auto|roll|pallas] [--no-errors] [--max-amp X] "
-    "[--no-watchdog] [--warmup N,TIMESTEPS[,K]] [--platform NAME] "
-    "[--version]"
+    "[--length-bucket-steps Q] [--kernel auto|roll|pallas] "
+    "[--no-errors] [--max-amp X] [--no-watchdog] "
+    "[--warmup N,TIMESTEPS[,K]] [--platform NAME] [--version]"
 )
 
 _KNOWN = (
     "host", "port", "max-batch", "max-wait-ms", "bucket-sizes",
-    "max-programs", "kernel", "no-errors", "max-amp", "no-watchdog",
-    "warmup", "platform", "version",
+    "max-programs", "length-bucket-steps", "kernel", "no-errors",
+    "max-amp", "no-watchdog", "warmup", "platform", "version",
 )
 _VALUELESS = ("no-errors", "no-watchdog", "version")
 
@@ -145,28 +151,67 @@ def parse_solve_request(body: dict, default_kernel: str = "auto"):
     if body.get("c2_field"):
         field = _c2_preset(problem, str(body["c2_field"]))
     phase = float(body.get("phase", 2.0 * 3.141592653589793))
-    if scheme == "compensated":
-        # The compensated lane loop serves the reference phase and
-        # constant speed only (ensemble/batched.py); reject here so the
-        # client gets a 400, not a batch-time 500.
-        if "phase" in body:
+    if scheme == "compensated" and field is not None:
+        # Compensated batches are constant-speed only (the field is not
+        # wired through the compensated vmapped core); reject here so
+        # the client gets a 400, not a batch-time 500.  Shifted phases
+        # DO batch on the compensated scheme (analytic bootstrap).
+        raise ValueError(
+            "scheme=compensated does not serve c2_field requests"
+        )
+    if scheme == "compensated" and dtype_name == "bf16":
+        # Same 400-not-500 reasoning: the compensated scheme requires
+        # an f32/f64 carrier (EnsembleSolver would refuse at build).
+        raise ValueError(
+            "scheme=compensated requires f32/f64 state (bf16 "
+            "representation error dominates what compensation recovers)"
+        )
+    mesh = body.get("mesh")
+    if mesh is not None:
+        import jax
+
+        mesh = tuple(int(m) for m in mesh)
+        if len(mesh) != 3 or any(m < 1 for m in mesh):
             raise ValueError(
-                "scheme=compensated serves the reference phase only"
+                f"mesh must be three positive ints [MX, MY, MZ], "
+                f"got {body.get('mesh')!r}"
+            )
+        n_dev = mesh[0] * mesh[1] * mesh[2]
+        if n_dev > len(jax.devices()):
+            raise ValueError(
+                f"mesh {mesh} needs {n_dev} devices, only "
+                f"{len(jax.devices())} available"
+            )
+        if scheme == "compensated":
+            raise ValueError(
+                "sharded x batched serves the standard scheme only"
+            )
+        if fuse_steps > 1:
+            raise ValueError(
+                "sharded x batched does not take fuse_steps (the "
+                "sharded lane marches the 1-step kernel)"
             )
         if field is not None:
             raise ValueError(
-                "scheme=compensated does not serve c2_field requests"
+                "sharded x batched does not serve c2_field requests"
             )
     lane = LaneSpec(phase=phase, stop_step=stop, c2tau2_field=field)
     # Surface lane-level errors (bad stop/k alignment) at parse time so
     # they 400 instead of failing the whole batch later.
-    from wavetpu.ensemble.batched import _validate
+    if mesh is not None:
+        from wavetpu.ensemble.sharded import _validate as _validate_sh
 
-    _validate(problem, [lane], path, fuse_steps if path == "kfused" else 2,
-              compute_errors=False)
+        _validate_sh(problem, [lane], path, compute_errors=False)
+    else:
+        from wavetpu.ensemble.batched import _validate
+
+        _validate(problem, [lane], path,
+                  fuse_steps if path == "kfused" else 2,
+                  compute_errors=False, scheme=scheme)
     return SolveRequest(
         problem=problem, lane=lane, scheme=scheme, path=path,
         k=fuse_steps if path == "kfused" else 1, dtype_name=dtype_name,
+        mesh_shape=mesh,
     )
 
 
@@ -207,7 +252,11 @@ def _ok_payload(result, batch_info: dict, errors_computed: bool) -> dict:
 
 
 class ServerState:
-    """Everything the handler needs, hung off the HTTPServer instance."""
+    """Everything the handler needs, hung off the HTTPServer instance.
+
+    `draining` flips on SIGTERM/SIGINT: new /solve requests get 503
+    while the batcher flushes what is already queued (graceful drain -
+    outstanding futures resolve with results, scheduler.close(drain))."""
 
     def __init__(self, engine, batcher, metrics, default_kernel: str,
                  request_timeout: float = 600.0):
@@ -217,6 +266,7 @@ class ServerState:
         self.default_kernel = default_kernel
         self.request_timeout = request_timeout
         self.started = time.time()
+        self.draining = False
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -256,6 +306,13 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(404, {"status": "error", "error": "not found"})
             return
         st = self.state
+        if st.draining:
+            st.metrics.observe_response(False)
+            self._send(503, {
+                "status": "error",
+                "error": "server draining (shutting down)",
+            })
+            return
         t0 = time.monotonic()
         try:
             length = int(self.headers.get("Content-Length", "0"))
@@ -304,11 +361,14 @@ def build_server(
     max_amp: Optional[float] = None,
     default_kernel: str = "auto",
     interpret: Optional[bool] = None,
+    length_bucket_steps: Optional[int] = None,
 ) -> Tuple[ThreadingHTTPServer, ServerState]:
     """Assemble engine + batcher + HTTP server (port 0 = ephemeral; the
     bound port is `httpd.server_address[1]`).  Returned httpd is not yet
     serving - call `serve_forever()` (main does) or drive it from a
-    thread (tests do)."""
+    thread (tests do).  `length_bucket_steps` turns on stop-length
+    bucketing in the scheduler (masked-lane FLOP control - see
+    DynamicBatcher)."""
     from wavetpu.serve.engine import ServeEngine
     from wavetpu.serve.scheduler import DynamicBatcher, ServeMetrics
 
@@ -319,7 +379,8 @@ def build_server(
     )
     metrics = ServeMetrics()
     batcher = DynamicBatcher(
-        engine, metrics=metrics, max_batch=max_batch, max_wait=max_wait
+        engine, metrics=metrics, max_batch=max_batch, max_wait=max_wait,
+        length_bucket_steps=length_bucket_steps,
     )
     httpd = ThreadingHTTPServer((host, port), _Handler)
     httpd.wavetpu_state = ServerState(
@@ -352,6 +413,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         )
         max_wait = float(flags.get("max-wait-ms", "25")) / 1e3
         max_programs = int(flags.get("max-programs", "8"))
+        length_bucket_steps = (
+            int(flags["length-bucket-steps"])
+            if "length-bucket-steps" in flags else None
+        )
         max_amp = float(flags["max-amp"]) if "max-amp" in flags else None
         kernel = flags.get("kernel", "auto")
         if kernel not in ("auto", "roll", "pallas"):
@@ -376,7 +441,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         max_wait=max_wait, max_programs=max_programs,
         compute_errors="no-errors" not in flags,
         watchdog="no-watchdog" not in flags, max_amp=max_amp,
-        default_kernel=kernel,
+        default_kernel=kernel, length_bucket_steps=length_bucket_steps,
     )
     if "warmup" in flags:
         parts = [int(x) for x in flags["warmup"].split(",")]
@@ -402,6 +467,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     import signal
 
     def _shutdown(signum, frame):
+        # Graceful drain: refuse new /solve (503) immediately, stop the
+        # accept loop, and let the finally-block flush what is queued.
+        state.draining = True
         threading.Thread(target=httpd.shutdown, daemon=True).start()
 
     signal.signal(signal.SIGTERM, _shutdown)
@@ -409,9 +477,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     try:
         httpd.serve_forever()
     finally:
-        state.batcher.close()
+        # drain=True resolves every outstanding future with its RESULT
+        # (queued batches are flushed through the engine) instead of
+        # erroring them; the generous timeout covers a full batch solve.
+        state.batcher.close(timeout=120.0, drain=True)
         httpd.server_close()
-    print("wavetpu serve: shut down cleanly")
+    print("wavetpu serve: shut down cleanly (drained)")
     return 0
 
 

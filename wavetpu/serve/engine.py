@@ -29,11 +29,16 @@ from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 from wavetpu.core.problem import Problem
 from wavetpu.ensemble import batched as ensemble
+from wavetpu.ensemble import sharded as ens_sharded
 from wavetpu.run import health
 
 
 class ProgramKey(NamedTuple):
-    """Identity of one compiled batched program (the cache key)."""
+    """Identity of one compiled batched program (the cache key).
+
+    `mesh` is None for single-device programs, or the (MX, MY, MZ) mesh
+    shape of a sharded x batched program (ensemble/sharded.py) - a
+    (mesh, batch-bucket) pair is its own compiled executable."""
 
     N: int
     Lx: float
@@ -48,17 +53,20 @@ class ProgramKey(NamedTuple):
     with_field: bool
     compute_errors: bool
     batch: int
+    mesh: Optional[Tuple[int, int, int]] = None
 
     @classmethod
     def for_batch(cls, problem: Problem, scheme: str, path: str, k: int,
                   dtype_name: str, with_field: bool, compute_errors: bool,
-                  batch: int) -> "ProgramKey":
+                  batch: int,
+                  mesh: Optional[Tuple[int, int, int]] = None
+                  ) -> "ProgramKey":
         return cls(
             N=problem.N, Lx=problem.Lx, Ly=problem.Ly, Lz=problem.Lz,
             T=problem.T, timesteps=problem.timesteps, scheme=scheme,
             path=path, k=k if path == "kfused" else 1, dtype=dtype_name,
             with_field=with_field, compute_errors=compute_errors,
-            batch=batch,
+            batch=batch, mesh=None if mesh is None else tuple(mesh),
         )
 
 
@@ -131,27 +139,44 @@ class ServeEngine:
     def program(
         self, problem: Problem, scheme: str, path: str, k: int,
         dtype_name: str, with_field: bool, batch: int,
-    ) -> Optional[ensemble.EnsembleSolver]:
+        mesh: Optional[Tuple[int, int, int]] = None,
+    ):
         """The cached compiled program for this key, building (and
         compiling) on miss - or None when the vmapped core cannot serve
-        the key (compensated scheme, or a failed capability probe): the
-        caller then runs the recorded lane-loop fallback."""
+        the key (failed capability probe): the caller then runs the
+        recorded lane-loop fallback.  `mesh` selects the sharded x
+        batched composition (ensemble/sharded.py); a (mesh, bucket) pair
+        is its own cached executable."""
         compute_errors = self.compute_errors and not with_field
-        if scheme != "standard":
-            self.fallbacks.setdefault(
-                f"scheme:{scheme}",
-                "compensated scheme is not wired into the vmapped core",
+        if mesh is not None:
+            if scheme != "standard":
+                # Refuse loudly: silently serving a compensated request
+                # with the standard scheme would be a wrong-result bug,
+                # not a fallback.  (The HTTP layer 400s this at parse;
+                # this guards direct ServeEngine users.)
+                raise ValueError(
+                    "sharded x batched serves the standard scheme only; "
+                    f"got scheme={scheme!r} with mesh {tuple(mesh)}"
+                )
+            ok, why = ens_sharded.vmap_capability(
+                mesh, kernel=path, interpret=self.interpret
             )
-            return None
-        ok, why = ensemble.vmap_capability(
-            path, k=k, interpret=self.interpret, with_field=with_field
-        )
-        if not ok:
-            self.fallbacks.setdefault(f"path:{path}", why)
-            return None
+            if not ok:
+                self.fallbacks.setdefault(
+                    f"mesh:{tuple(mesh)}:{path}", why
+                )
+                return None
+        else:
+            ok, why = ensemble.vmap_capability(
+                path, k=k, interpret=self.interpret,
+                with_field=with_field, scheme=scheme,
+            )
+            if not ok:
+                self.fallbacks.setdefault(f"{scheme}:{path}", why)
+                return None
         key = ProgramKey.for_batch(
             problem, scheme, path, k, dtype_name, with_field,
-            compute_errors, batch,
+            compute_errors, batch, mesh,
         )
         with self._lock:
             prog = self._programs.get(key)
@@ -162,11 +187,19 @@ class ServeEngine:
             self.misses += 1
         # Build + compile OUTSIDE the lock (XLA compiles can take
         # seconds; warmup from another thread must not serialize on it).
-        prog = ensemble.EnsembleSolver(
-            problem, batch, dtype=self._dtype(dtype_name), path=path, k=k,
-            compute_errors=compute_errors, interpret=self.interpret,
-            block_x=self.block_x, with_field=with_field,
-        )
+        if mesh is not None:
+            prog = ens_sharded.ShardedEnsembleSolver(
+                problem, batch, mesh, dtype=self._dtype(dtype_name),
+                kernel=path, compute_errors=compute_errors,
+                interpret=self.interpret,
+            )
+        else:
+            prog = ensemble.EnsembleSolver(
+                problem, batch, dtype=self._dtype(dtype_name), path=path,
+                k=k, compute_errors=compute_errors,
+                interpret=self.interpret, block_x=self.block_x,
+                with_field=with_field, scheme=scheme,
+            )
         prog.compile()
         with self._lock:
             self._programs[key] = prog
@@ -180,14 +213,16 @@ class ServeEngine:
         self, problem: Problem, scheme: str = "standard",
         path: str = "roll", k: int = 4, dtype_name: str = "f32",
         with_field: bool = False, batches: Optional[Sequence[int]] = None,
+        mesh: Optional[Tuple[int, int, int]] = None,
     ) -> List[int]:
         """AOT-compile the key for each requested bucket (default: all);
         returns the bucket sizes actually warmed (empty when the path
-        falls back - recorded, not raised)."""
+        falls back - recorded, not raised).  `mesh` warms the sharded x
+        batched (mesh, bucket) programs."""
         warmed = []
         for b in (self.bucket_sizes if batches is None else batches):
             if self.program(
-                problem, scheme, path, k, dtype_name, with_field, b
+                problem, scheme, path, k, dtype_name, with_field, b, mesh
             ) is not None:
                 warmed.append(b)
         return warmed
@@ -202,6 +237,13 @@ class ServeEngine:
                 "evictions": self.evictions,
                 "keys": [list(k) for k in self._programs],
                 "fallbacks": dict(self.fallbacks),
+                # Every cached vmap-capability verdict (single-device +
+                # sharded): a chip silently serving lane-loop is visible
+                # from the outside via these.
+                "vmap_probes": (
+                    ensemble.probe_results()
+                    + ens_sharded.probe_results()
+                ),
             }
 
     # ---- execution ----
@@ -253,24 +295,38 @@ class ServeEngine:
         self, problem: Problem, lanes: Sequence[ensemble.LaneSpec],
         scheme: str = "standard", path: str = "roll", k: int = 4,
         dtype_name: str = "f32",
+        mesh: Optional[Tuple[int, int, int]] = None,
     ) -> Tuple[ensemble.EnsembleResult, List[Optional[str]]]:
         """Pad to the bucket, run the cached program (or the recorded
         fallback), watchdog each lane; returns (EnsembleResult,
-        per-lane health)."""
+        per-lane health).  `mesh` routes the batch through the sharded x
+        batched composition."""
         lanes = list(lanes)
         with_field = any(lane.c2tau2_field is not None for lane in lanes)
         compute_errors = self.compute_errors and not with_field
         bucket = self.bucket_for(len(lanes))
         prog = self.program(
-            problem, scheme, path, k, dtype_name, with_field, bucket
+            problem, scheme, path, k, dtype_name, with_field, bucket, mesh
         )
-        result = ensemble.solve_ensemble(
-            problem, lanes, dtype=self._dtype(dtype_name), scheme=scheme,
-            path=path, k=k, compute_errors=compute_errors,
-            interpret=self.interpret, block_x=self.block_x,
-            pad_to=bucket if prog is not None else None,
-            solver=prog,
-        )
+        if mesh is not None:
+            result = ens_sharded.solve_ensemble_sharded(
+                problem, lanes, mesh_shape=mesh,
+                dtype=self._dtype(dtype_name), kernel=path,
+                compute_errors=compute_errors, interpret=self.interpret,
+                pad_to=bucket if prog is not None else None,
+                solver=prog,
+            )
+        else:
+            result = ensemble.solve_ensemble(
+                problem, lanes, dtype=self._dtype(dtype_name),
+                scheme=scheme, path=path, k=k,
+                compute_errors=compute_errors,
+                interpret=self.interpret, block_x=self.block_x,
+                pad_to=bucket if prog is not None else None,
+                solver=prog,
+            )
         if not result.batched and result.fallback_reason:
-            self.fallbacks.setdefault(f"path:{path}", result.fallback_reason)
+            self.fallbacks.setdefault(
+                f"{scheme}:{result.path}", result.fallback_reason
+            )
         return result, self.lane_health(result)

@@ -38,7 +38,11 @@ from wavetpu.ensemble.batched import LaneSpec
 
 @dataclasses.dataclass
 class SolveRequest:
-    """One lane's worth of work plus its program identity."""
+    """One lane's worth of work plus its program identity.
+
+    `mesh_shape` routes the request through the sharded x batched
+    composition (ensemble/sharded.py); only same-mesh requests share a
+    program."""
 
     problem: Problem
     lane: LaneSpec
@@ -46,6 +50,7 @@ class SolveRequest:
     path: str = "roll"
     k: int = 1
     dtype_name: str = "f32"
+    mesh_shape: Optional[Tuple[int, int, int]] = None
 
     def bucket_key(self) -> Tuple:
         """Everything the compiled program identity depends on; only
@@ -57,6 +62,7 @@ class SolveRequest:
             self.k if self.path == "kfused" else 1,
             self.dtype_name,
             self.lane.c2tau2_field is not None,
+            None if self.mesh_shape is None else tuple(self.mesh_shape),
         )
 
 
@@ -159,10 +165,28 @@ class DynamicBatcher:
     `concurrent.futures.Future`); `close()` joins the worker, then fails
     every still-unresolved future - both the worker's stash and anything
     left in (or racing into) the queue - with a RuntimeError.
+    `close(drain=True)` is the graceful-shutdown path: new submits are
+    refused, but everything already queued is FLUSHED through the engine
+    (batched as usual, no max-wait idling) and every outstanding future
+    resolves with its result instead of an error.
+
+    `length_bucket_steps` is the occupancy/latency knob for diverging
+    stop_steps: per-lane masking marches every lane to the batch's
+    longest stop, so a 10-step request batched with a 1000-step one
+    burns ~990 masked-lane steps of FLOPs.  With the knob set, requests
+    are additionally bucketed by stop-length quantum - the quantum
+    rounded UP to a multiple of the request's k so bucket boundaries sit
+    on the onion's k-block grid - and only same-length-bucket requests
+    share a batch: tighter buckets waste fewer masked steps but split
+    traffic across more batches (lower occupancy).  Starvation is
+    bounded: stashed non-matching requests keep arrival order and the
+    worker serves the OLDEST stashed request as the next batch's leader,
+    so a request waits at most one batch per distinct key ahead of it.
     """
 
     def __init__(self, engine, metrics: Optional[ServeMetrics] = None,
-                 max_batch: Optional[int] = None, max_wait: float = 0.025):
+                 max_batch: Optional[int] = None, max_wait: float = 0.025,
+                 length_bucket_steps: Optional[int] = None):
         self.engine = engine
         self.metrics = metrics if metrics is not None else ServeMetrics()
         self.max_batch = (
@@ -171,33 +195,76 @@ class DynamicBatcher:
         )
         if self.max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        if length_bucket_steps is not None and length_bucket_steps < 1:
+            raise ValueError(
+                f"length_bucket_steps must be >= 1, got "
+                f"{length_bucket_steps}"
+            )
         self.max_wait = max_wait
+        self.length_bucket_steps = length_bucket_steps
         self._q: "queue.Queue[_Item]" = queue.Queue()
         self._pending: "deque[_Item]" = deque()
+        # Guards _pending: the worker mutates it between batches and
+        # close() sweeps it after the join timeout - which can expire
+        # while a drain is still executing batches, so the sweep must
+        # not race the worker's stash bookkeeping.
+        self._plock = threading.Lock()
         self._closed = False
+        self._drain = False
         self._worker = threading.Thread(
             target=self._loop, name="wavetpu-batcher", daemon=True
         )
         self._worker.start()
 
+    def length_bucket(self, request: SolveRequest) -> int:
+        """The request's stop-length bucket id (0 when the knob is off).
+
+        The quantum is rounded up to a multiple of the request's k, so
+        every bucket boundary sits on the k-block grid the onion's lane
+        masking freezes on."""
+        if self.length_bucket_steps is None:
+            return 0
+        q = self.length_bucket_steps
+        k = request.k if request.path == "kfused" else 1
+        q = ((q + k - 1) // k) * k
+        return (request.lane.stop(request.problem) - 1) // q
+
+    def _item_key(self, request: SolveRequest) -> Tuple:
+        return request.bucket_key() + (self.length_bucket(request),)
+
     def submit(self, request: SolveRequest) -> Future:
         if self._closed:
             raise RuntimeError("batcher is closed")
-        item = _Item(request, Future(), request.bucket_key())
+        item = _Item(request, Future(), self._item_key(request))
         self.metrics.observe_request()
         self._q.put(item)
         return item.future
 
-    def close(self, timeout: float = 5.0) -> None:
+    def close(self, timeout: float = 5.0, drain: bool = False) -> None:
+        """Stop the worker.  `drain=True` flushes everything already
+        queued through the engine first (graceful SIGTERM shutdown):
+        outstanding futures resolve with RESULTS; only what the worker
+        could not finish within `timeout` is failed."""
+        self._drain = drain
         self._closed = True
         self._q.put(None)  # wake the worker
         self._worker.join(timeout)
+        if self._worker.is_alive():
+            # The drain outlived the timeout (it does unbounded engine
+            # work).  Tell the worker to stop after its in-flight batch
+            # and give it a short grace to exit; the sweep below then
+            # fails what it could not finish - under _plock, so a
+            # worker that is STILL mid-batch cannot race the stash.
+            self._drain = False
+            self._worker.join(min(timeout, 5.0))
         # Fail EVERY unresolved future: the worker's stash plus anything
         # still in the queue (including a submit that raced past the
         # _closed check) - a blocked HTTP handler must get its 500, not
-        # sit out the full request timeout.
-        leftovers = list(self._pending)
-        self._pending.clear()
+        # sit out the full request timeout.  After a completed drain
+        # there is nothing left here and this is a no-op.
+        with self._plock:
+            leftovers = list(self._pending)
+            self._pending.clear()
         while True:
             try:
                 item = self._q.get_nowait()
@@ -210,36 +277,61 @@ class DynamicBatcher:
                 item.future.set_exception(
                     RuntimeError("server shutting down")
                 )
+        if self._worker.is_alive():
+            # The sweep above may have eaten the wake sentinel; re-post
+            # it so a worker still finishing its batch can observe
+            # _closed and exit instead of blocking on an empty queue.
+            self._q.put(None)
 
     # ---- worker ----
 
     def _take_pending(self, key, limit: int) -> List[_Item]:
         taken, keep = [], deque()
-        while self._pending:
-            item = self._pending.popleft()
-            if item.key == key and len(taken) < limit:
-                taken.append(item)
-            else:
-                keep.append(item)
-        self._pending = keep
+        with self._plock:
+            while self._pending:
+                item = self._pending.popleft()
+                if item.key == key and len(taken) < limit:
+                    taken.append(item)
+                else:
+                    keep.append(item)
+            self._pending.extend(keep)
         return taken
+
+    def _drain_queue(self) -> None:
+        """Move everything still in the queue onto the pending stash
+        (arrival order preserved) - the drain path's intake."""
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if item is not None:
+                with self._plock:
+                    self._pending.append(item)
 
     def _loop(self) -> None:
         while True:
-            if self._pending:
-                first = self._pending.popleft()
-            else:
+            if self._closed:
+                if not self._drain:
+                    return
+                self._drain_queue()
+                if not self._pending:
+                    return
+            with self._plock:
+                first = self._pending.popleft() if self._pending else None
+            if first is None:
                 item = self._q.get()
                 if item is None:
-                    if self._closed:
-                        return
-                    continue
+                    continue  # sentinel: loop back to the closed check
                 first = item
             batch = [first]
             batch += self._take_pending(
                 first.key, self.max_batch - len(batch)
             )
-            deadline = time.monotonic() + self.max_wait
+            # While draining, skip the max-wait idle: flush immediately.
+            deadline = time.monotonic() + (
+                0.0 if self._closed else self.max_wait
+            )
             while len(batch) < self.max_batch:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
@@ -249,14 +341,15 @@ class DynamicBatcher:
                 except queue.Empty:
                     break
                 if nxt is None:
-                    if self._closed:
-                        self._execute(batch)
-                        return
-                    continue
+                    # Sentinel mid-collection: execute what we have; the
+                    # outer loop then drains (or returns, leaving
+                    # close() to fail the stash).
+                    break
                 if nxt.key == first.key:
                     batch.append(nxt)
                 else:
-                    self._pending.append(nxt)
+                    with self._plock:
+                        self._pending.append(nxt)
             self._execute(batch)
 
     def _execute(self, batch: List[_Item]) -> None:
@@ -266,11 +359,12 @@ class DynamicBatcher:
                 req0.problem,
                 [item.request.lane for item in batch],
                 scheme=req0.scheme, path=req0.path, k=req0.k,
-                dtype_name=req0.dtype_name,
+                dtype_name=req0.dtype_name, mesh=req0.mesh_shape,
             )
         except Exception as e:
             for item in batch:
-                item.future.set_exception(e)
+                if not item.future.done():
+                    item.future.set_exception(e)
             return
         cells = sum(
             req0.problem.cells_per_step * (r.steps_computed or 0)
@@ -291,6 +385,10 @@ class DynamicBatcher:
             ),
         }
         for i, item in enumerate(batch):
-            item.future.set_result(
-                (result.results[i], lane_health[i], batch_info)
-            )
+            # done() guard: a close() that timed out may have failed
+            # this future already; a second set_ would raise
+            # InvalidStateError inside the worker.
+            if not item.future.done():
+                item.future.set_result(
+                    (result.results[i], lane_health[i], batch_info)
+                )

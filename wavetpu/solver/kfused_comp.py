@@ -60,6 +60,7 @@ from wavetpu.core.problem import Problem
 from wavetpu import compat
 from wavetpu.kernels import stencil_pallas, stencil_ref
 from wavetpu.solver import kfused, leapfrog
+from wavetpu.verify import oracle
 
 
 def _default_carry_dtype(dtype):
@@ -109,7 +110,8 @@ def _normalize_carry(carry, dtype):
 
 
 def _validate(problem: Problem, dtype, v_dtype, carry, k: int,
-              c2tau2_field=None, compute_errors: bool = True):
+              c2tau2_field=None, compute_errors: bool = True,
+              phase: float = oracle.TWO_PI):
     if k < 2:
         raise ValueError(f"k must be >= 2 (got {k}); use "
                          "leapfrog.solve_compensated for k=1")
@@ -119,6 +121,12 @@ def _validate(problem: Problem, dtype, v_dtype, carry, k: int,
         raise ValueError(
             "variable-c runs have no analytic oracle; pass "
             "compute_errors=False with c2tau2_field"
+        )
+    if c2tau2_field is not None and phase != oracle.TWO_PI:
+        raise ValueError(
+            "a shifted phase bootstraps layers 0/1 from the analytic "
+            "solution, which only exists for constant speed; use the "
+            "reference phase with c2tau2_field"
         )
     if dtype == jnp.bfloat16:
         raise ValueError(
@@ -140,17 +148,16 @@ def _rel_guard_tol(f):
     return 512 * jnp.finfo(f).eps
 
 
-def _error_fn_guarded(problem: Problem, dtype):
+def _error_fn_guarded(problem: Problem, dtype,
+                      phase: float = oracle.TWO_PI):
     """Layer-error fn with the representation-zero sx planes excluded,
     so the bootstrap layer's metric matches the in-kernel layers'.
 
     (The excluded plane's ABS contribution is ~1e-16 * |syz| - far below
     any solver error - so abs is unchanged in practice.)"""
-    from wavetpu.verify import oracle
-
     f_dtype = stencil_ref.compute_dtype(dtype)
     sx, sy, sz = oracle.spatial_factors(problem, f_dtype)
-    ct_table = oracle.time_factor_table(problem, f_dtype)
+    ct_table = oracle.time_factor_table(problem, f_dtype, phase)
     mask = jnp.asarray(oracle.interior_masks_1d(problem.N))
     mask_x = mask & (jnp.abs(sx) > _rel_guard_tol(f_dtype))
 
@@ -163,7 +170,7 @@ def _error_fn_guarded(problem: Problem, dtype):
 
 def _make_march(problem, dtype, v_dtype, carry_on, k, compute_errors,
                 block_x, interpret, nsteps, has_field=False,
-                chunk_len=None):
+                chunk_len=None, phase: float = oracle.TWO_PI):
     """Shared march: k-fused blocks + a k=1 tail through the SAME kernel.
 
     Returns `march(u, v, carry, start, *field_params)` ->
@@ -177,7 +184,9 @@ def _make_march(problem, dtype, v_dtype, carry_on, k, compute_errors,
     argument (leapfrog.ParamStep reasoning) into every onion call.
     """
     f = stencil_ref.compute_dtype(dtype)
-    sx, ct, syz, rsyz, xmask, inv_absx = kfused._oracle_parts(problem, f)
+    sx, ct, syz, rsyz, xmask, inv_absx = kfused._oracle_parts(
+        problem, f, phase
+    )
     # Rel-metric guard: exclude REPRESENTATION-LEVEL zeros of the periodic
     # x factor (sin at the domain midpoint evaluates to ~1.2e-16, not 0,
     # so the exact-zero NaN-skip of the reference contract misses it and
@@ -250,14 +259,28 @@ def _make_march(problem, dtype, v_dtype, carry_on, k, compute_errors,
 
 
 def _bootstrap(problem, dtype, v_dtype, carry_on, carry_dtype, interpret,
-               field=None):
+               field=None, phase: float = oracle.TWO_PI):
     """Layers 0/1: analytic init + the compensated kernel's half-step.
 
     u1 = u0 + (C/2)lap(u0) with v = carry = 0 primes (u1, v1, carry1)
     exactly as `leapfrog.make_compensated_solver` (reference bootstrap:
     openmp_sol.cpp:123-145).  With a `field` the half-step coefficient is
     tau^2 c^2(x)/2 and the k=1 onion kernel runs it (op-for-op the same
-    Kahan sequence, with the field as the Laplacian coefficient)."""
+    Kahan sequence, with the field as the Laplacian coefficient).
+
+    A shifted `phase` (constant speed only - _validate) takes the exact
+    analytic two-level initialization instead: u0/u1 analytic, v1 the
+    exact analytic increment (leapfrog.analytic_increment_layer1, a
+    pure product - never u1 - u0, whose FMA contraction drifts between
+    program shapes), zero Kahan carry - the leapfrog analytic bootstrap
+    with the onion's storage dtypes."""
+    if phase != oracle.TWO_PI:
+        u1 = leapfrog.analytic_layer(problem, dtype, phase, 1)
+        v1 = leapfrog.analytic_increment_layer1(problem, v_dtype, phase)
+        c1 = (
+            jnp.zeros(u1.shape, carry_dtype) if carry_on else None
+        )
+        return u1, v1, c1
     u0 = leapfrog.initial_layer0(problem, dtype)
     if field is None:
         zero = jnp.zeros_like(u0)
@@ -293,6 +316,7 @@ def make_kfused_comp_solver(
     carry: bool = True,
     carry_dtype=None,
     c2tau2_field=None,
+    phase: float = oracle.TWO_PI,
 ):
     """Build the jitted compensated k-fused solver; returns
     `(runner, run_params)` yielding (u, v, carry|None, abs_errors,
@@ -302,7 +326,9 @@ def make_kfused_comp_solver(
 
     `carry_dtype` (default: `_default_carry_dtype`, i.e. bf16 for f32
     runs) narrows only the carry's HBM stream - see that helper for the
-    numerics and the measured +6%.
+    numerics and the measured +6%.  `phase` is the lane identity of the
+    ensemble engine (analytic two-level bootstrap when shifted; constant
+    speed only - see `_bootstrap`).
     """
     v_dtype = dtype if v_dtype is None else jnp.dtype(v_dtype)
     carry_dtype = (
@@ -312,7 +338,7 @@ def make_kfused_comp_solver(
     if carry:
         _validate_carry_dtype(dtype, carry_dtype)
     _validate(problem, dtype, v_dtype, carry, k, c2tau2_field,
-              compute_errors)
+              compute_errors, phase)
     nsteps = problem.timesteps if stop_step is None else stop_step
     if not 1 <= nsteps <= problem.timesteps:
         raise ValueError(
@@ -320,16 +346,16 @@ def make_kfused_comp_solver(
         )
     f = stencil_ref.compute_dtype(dtype)
     has_field = c2tau2_field is not None
-    errors = _error_fn_guarded(problem, dtype)
+    errors = _error_fn_guarded(problem, dtype, phase)
     march = _make_march(
         problem, dtype, v_dtype, carry, k, compute_errors, block_x,
-        interpret, nsteps, has_field,
+        interpret, nsteps, has_field, phase=phase,
     )
 
     def run(*field_params):
         u1, v1, c1 = _bootstrap(
             problem, dtype, v_dtype, carry, carry_dtype, interpret,
-            field_params[0] if has_field else None,
+            field_params[0] if has_field else None, phase,
         )
         a0 = r0 = jnp.zeros((), f)
         if compute_errors:
@@ -379,14 +405,16 @@ def solve_kfused_comp(
     carry: bool = True,
     carry_dtype=None,
     c2tau2_field=None,
+    phase: float = oracle.TWO_PI,
 ) -> leapfrog.SolveResult:
     """Compile + run the compensated k-fused solve (reference timing
     phases as `leapfrog.solve`).  `c2tau2_field` selects the variable-c
     velocity-form onion (composes with the carry and the bf16-increment
-    mode); pair it with compute_errors=False."""
+    mode); pair it with compute_errors=False.  `phase` shifts the
+    analytic initial condition (constant speed only)."""
     runner, run_params = make_kfused_comp_solver(
         problem, dtype, k, compute_errors, stop_step, block_x, interpret,
-        v_dtype, carry, carry_dtype, c2tau2_field,
+        v_dtype, carry, carry_dtype, c2tau2_field, phase,
     )
     out, init_s, solve_s = leapfrog._timed_compile_run(
         runner, run_params, sync=lambda o: np.asarray(o[3])

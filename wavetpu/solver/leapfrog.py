@@ -147,6 +147,31 @@ def initial_layer0(
     return analytic_layer(problem, dtype, phase, 0)
 
 
+def analytic_increment_layer1(
+    problem: Problem, dtype=jnp.float32, phase: float = oracle.TWO_PI
+) -> jax.Array:
+    """The exact analytic layer-0->1 increment Sx Sy Sz (ct(1) - ct(0)),
+    Dirichlet re-imposed - the v1 a shifted-phase COMPENSATED solve
+    bootstraps with (the increment of the exact two-level
+    initialization).
+
+    Deliberately a pure product, NOT u1 - u0: XLA-CPU FMA-contracts the
+    field subtract with the analytic product feeding it differently
+    between solo and vmapped program shapes (measured ~1 ulp on this
+    jaxlib), which would break the ensemble's bitwise lane-parity
+    contract; a product-only expression compiles identically everywhere
+    (the same reasoning that picked the analytic bootstrap over a
+    tau*u_t correction term - see make_solver)."""
+    f = stencil_ref.compute_dtype(dtype)
+    sx, sy, sz = oracle.spatial_factors(problem, f)
+    dct = (
+        oracle.time_factor(problem, 1, f, phase)
+        - oracle.time_factor(problem, 0, f, phase)
+    )
+    u = oracle.analytic_field(sx, sy, sz, dct)
+    return stencil_ref.apply_dirichlet(u).astype(dtype)
+
+
 def initial_state(problem: Problem, dtype=jnp.float32) -> Tuple[jax.Array, jax.Array]:
     """Layers 0 and 1: analytic init + (constant-speed) Taylor half-step.
 
@@ -378,6 +403,7 @@ def make_compensated_solver(
     comp_step_fn: Optional[Callable] = None,
     compute_errors: bool = True,
     stop_step: Optional[int] = None,
+    phase: float = oracle.TWO_PI,
 ):
     """Jitted end-to-end solver on the compensated (Kahan) incremental
     scheme - see stencil_ref.compensated_step for the numerics and the
@@ -388,6 +414,14 @@ def make_compensated_solver(
     via `stencil_pallas.make_compensated_step_fn()`.  The scheme exists to
     push f32 to the discretization limit; bf16 state is rejected (its
     representation error alone dwarfs what compensation recovers).
+
+    `phase` follows `make_solver`'s contract (lane identity in the
+    ensemble engine): a shifted phase initializes layers 0/1 from the
+    ANALYTIC solution, with v1 the exact analytic increment
+    (`analytic_increment_layer1` - in exact arithmetic the next step
+    then reproduces 2u1 - u0 + C lap(u1), the standard leapfrog update)
+    and a zero Kahan carry; the reference phase keeps the step-derived
+    half-step bootstrap bit-identically.
     """
     if dtype == jnp.bfloat16:
         raise ValueError(
@@ -398,7 +432,8 @@ def make_compensated_solver(
         comp_step_fn if comp_step_fn is not None
         else stencil_ref.compensated_step
     )
-    errors = _error_fn(problem, dtype)
+    errors = _error_fn(problem, dtype, phase)
+    analytic_bootstrap = phase != oracle.TWO_PI
     nsteps = problem.timesteps if stop_step is None else stop_step
     if not 1 <= nsteps <= problem.timesteps:
         raise ValueError(
@@ -406,12 +441,17 @@ def make_compensated_solver(
         )
 
     def run():
-        u0 = initial_layer0(problem, dtype)
-        zero = jnp.zeros_like(u0)
-        # Layer 1 = the same step with v = carry = 0 and coeff = C/2:
-        # u1 = u0 + (C/2)lap(u0), the Taylor half-step, with v1/carry1
-        # correctly primed for the loop.
-        u1, v1, c1 = step(u0, zero, zero, problem, 0.5 * problem.a2tau2)
+        u0 = initial_layer0(problem, dtype, phase)
+        if analytic_bootstrap:
+            u1 = analytic_layer(problem, dtype, phase, 1)
+            v1 = analytic_increment_layer1(problem, dtype, phase)
+            c1 = jnp.zeros_like(u0)
+        else:
+            zero = jnp.zeros_like(u0)
+            # Layer 1 = the same step with v = carry = 0 and coeff = C/2:
+            # u1 = u0 + (C/2)lap(u0), the Taylor half-step, with v1/carry1
+            # correctly primed for the loop.
+            u1, v1, c1 = step(u0, zero, zero, problem, 0.5 * problem.a2tau2)
         a0 = r0 = jnp.zeros((), dtype)
         if compute_errors:
             a1, r1 = errors(u1, 1)
@@ -447,11 +487,12 @@ def solve_compensated(
     comp_step_fn: Optional[Callable] = None,
     compute_errors: bool = True,
     stop_step: Optional[int] = None,
+    phase: float = oracle.TWO_PI,
 ) -> SolveResult:
     """Compile + run the compensated-scheme solve (see
     make_compensated_solver)."""
     runner = make_compensated_solver(
-        problem, dtype, comp_step_fn, compute_errors, stop_step
+        problem, dtype, comp_step_fn, compute_errors, stop_step, phase
     )
     (u_prev, u_cur, v, carry, abs_all, rel_all), init_s, solve_s = (
         _timed_compile_run(runner, (), sync=lambda out: np.asarray(out[4]))

@@ -366,8 +366,14 @@ def _local_solve_fns(
     overlap: bool,
     interpret: bool,
     scheme: str = "standard",
+    phase: float = oracle.TWO_PI,
 ):
-    """The per-shard solve/resume bodies (closed over by shard_map)."""
+    """The per-shard solve/resume bodies (closed over by shard_map).
+
+    `phase` shifts the analytic initial condition (ensemble lane
+    identity): a shifted phase bootstraps layer 1 ANALYTICALLY (the
+    exact two-level initialization - leapfrog.make_solver's reasoning),
+    standard scheme only."""
     f = stencil_ref.compute_dtype(dtype)
     if scheme not in ("standard", "compensated"):
         raise ValueError(
@@ -377,6 +383,13 @@ def _local_solve_fns(
     if compensated and overlap:
         raise ValueError("overlap mode is not available for the "
                          "compensated scheme yet")
+    analytic_bootstrap = phase != oracle.TWO_PI
+    if analytic_bootstrap and compensated:
+        raise ValueError(
+            "the sharded compensated scheme serves the reference phase "
+            "only (use the single-device compensated solvers for "
+            "shifted-phase lanes)"
+        )
     if compensated:
         comp_step = _make_local_comp_step(
             problem, topo, dtype, kernel, interpret
@@ -419,6 +432,13 @@ def _local_solve_fns(
                 u0, zero, zero, bc, 0.5 * problem.a2tau2
             )
             return bc, (u1, v1, c1), u1
+        if analytic_bootstrap:
+            # Shifted phases have nonzero initial velocity; layer 1 is
+            # the exact analytic initialization (leapfrog.make_solver).
+            u1 = (
+                oracle.analytic_field(sx, sy, sz, ct[1]) * bc
+            ).astype(dtype)
+            return bc, (u0, u1), u1
         # Layer 1 derived from the step function (u1 = (u0 + step(u0, u0))/2
         # == u0 + C/2 lap(u0)), so the kernel choice and a variable-c field
         # bootstrap consistently - same trick as leapfrog.make_solver.
@@ -459,12 +479,12 @@ def _local_solve_fns(
     return errors_fn, bootstrap, scan_layers, final_state
 
 
-def _replicated_inputs(problem, topo, dtype):
+def _replicated_inputs(problem, topo, dtype, phase: float = oracle.TWO_PI):
     """The small closed-over program inputs (factors, masks, time table)."""
     f = stencil_ref.compute_dtype(dtype)
     sx, sy, sz = _padded_factors(problem, topo, f)
     (bcx, bcy, bcz), (mex, mey, mez) = _masks(problem, topo, f)
-    ct = oracle.time_factor_table(problem, f)
+    ct = oracle.time_factor_table(problem, f, phase)
     return (sx, sy, sz), (bcx, bcy, bcz), (mex, mey, mez), ct
 
 
@@ -480,6 +500,7 @@ def make_sharded_solver(
     has_field: bool = False,
     stop_step: Optional[int] = None,
     scheme: str = "standard",
+    phase: float = oracle.TWO_PI,
 ):
     """Build the jitted end-to-end sharded solver.
 
@@ -487,7 +508,9 @@ def make_sharded_solver(
     `has_field`, `runner(field)` with `field` a padded (topo.padded)
     tau^2 c^2 array (sharded or host; jit shards it P("x","y","z")).
     Output is (u_prev, u_cur, abs_errs, rel_errs) with u_* sharded
-    P("x","y","z") and the error vectors replicated.
+    P("x","y","z") and the error vectors replicated.  `phase` shifts the
+    analytic initial condition (standard scheme, constant speed only -
+    the analytic layer-1 bootstrap has no closed form under variable c).
     """
     nsteps = problem.timesteps if stop_step is None else stop_step
     if not 1 <= nsteps <= problem.timesteps:
@@ -495,14 +518,22 @@ def make_sharded_solver(
             f"stop_step must be in [1, {problem.timesteps}], got {nsteps}"
         )
     f = stencil_ref.compute_dtype(dtype)
-    (sx, sy, sz), bcs, mes, ct = _replicated_inputs(problem, topo, dtype)
+    if phase != oracle.TWO_PI and has_field:
+        raise ValueError(
+            "a shifted phase bootstraps layer 1 from the analytic "
+            "solution, which only exists for constant speed; use the "
+            "reference phase with c2tau2_field"
+        )
+    (sx, sy, sz), bcs, mes, ct = _replicated_inputs(
+        problem, topo, dtype, phase
+    )
     if scheme == "compensated" and has_field:
         raise ValueError(
             "compensated scheme does not support a variable-c field yet"
         )
     errors_fn, bootstrap, scan_layers, final_state = _local_solve_fns(
         problem, topo, dtype, compute_errors, kernel, overlap, interpret,
-        scheme,
+        scheme, phase,
     )
 
     compensated = scheme == "compensated"
@@ -772,6 +803,7 @@ def solve_sharded(
     c2tau2_field: Optional[np.ndarray] = None,
     stop_step: Optional[int] = None,
     scheme: str = "standard",
+    phase: float = oracle.TWO_PI,
 ) -> SolveResult:
     """Compile + run the distributed solve; returns the same SolveResult as
     the single-device path (errors are cross-device maxima).
@@ -783,7 +815,9 @@ def solve_sharded(
     compute/communication overlap (even shard splits only).
     `c2tau2_field` is an (N, N, N) host array from
     `stencil_ref.make_c2tau2_field`; pair it with compute_errors=False
-    (the analytic oracle holds for constant speed only).
+    (the analytic oracle holds for constant speed only).  `phase` shifts
+    the analytic initial condition (standard scheme, constant speed
+    only) - the lane identity of the sharded ensemble engine.
     """
     topo, mesh = _resolve_mesh(problem, mesh_shape, devices)
     if interpret is None:
@@ -791,7 +825,7 @@ def solve_sharded(
     has_field = c2tau2_field is not None
     runner = make_sharded_solver(
         problem, topo, mesh, dtype, compute_errors, kernel, overlap,
-        interpret, has_field, stop_step, scheme,
+        interpret, has_field, stop_step, scheme, phase,
     )
     rt_args = ()
     if has_field:
