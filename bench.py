@@ -151,6 +151,82 @@ def _supervised_row(problem, head, interp):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _telemetry_row(problem, head, interp):
+    """The headline config re-run with unified telemetry LIVE (tracer +
+    heartbeat thread, --telemetry-dir equivalent) - the overhead proof
+    for the observability layer.
+
+    The comparison is WALL time around the full entry point, NOT the
+    solver-internal solve_seconds (which is timed inside the entry point
+    and so structurally excludes span emission, record_solve counter
+    updates, and heartbeat interference - the very costs this row
+    exists to bound).  Each side is best-of-2 net of its own compile
+    (wall - init_seconds); `telemetry_overhead_pct_vs_headline` must
+    stay <= 2, else instrumentation crept into a hot path."""
+    import os
+    import shutil
+    import tempfile
+    import time
+    import traceback
+
+    from wavetpu.obs import telemetry, tracing
+    from wavetpu.solver import kfused_comp
+
+    def net_wall():
+        t0 = time.perf_counter()
+        res = kfused_comp.solve_kfused_comp(problem, k=4, interpret=interp)
+        return time.perf_counter() - t0 - res.init_seconds, res
+
+    d = tempfile.mkdtemp(prefix="wavetpu-bench-tel-")
+    try:
+        # Untraced side measured HERE, same harness, back to back -
+        # comparing against the headline row's internal timer would
+        # compare two different clocks.
+        untraced = min(net_wall()[0] for _ in range(2))
+        tel = telemetry.start(d, interval=5.0)
+        try:
+            traced_runs = []
+            best = None
+            for _ in range(2):
+                with tracing.span("bench.solve", config="headline"):
+                    wall, res = net_wall()
+                traced_runs.append(round(wall, 3))
+                if best is None or wall < best[0]:
+                    best = (wall, res)
+        finally:
+            tel.stop()
+        traced, res = best
+        with open(os.path.join(d, "trace.jsonl")) as f:
+            spans = sum(1 for line in f if line.strip())
+        with open(os.path.join(d, "heartbeat.jsonl")) as f:
+            beats = sum(1 for line in f if line.strip())
+        return {
+            "gcells_per_s": round(res.gcells_per_second, 3),
+            "max_abs_error": float(res.abs_errors.max()),
+            "solve_seconds": round(res.solve_seconds, 3),
+            "untraced_net_wall_seconds": round(untraced, 3),
+            "traced_net_wall_seconds": round(traced, 3),
+            "traced_run_seconds": traced_runs,
+            "telemetry_overhead_pct_vs_headline": round(
+                100.0 * (traced - untraced) / untraced, 2
+            ) if untraced > 0 else None,
+            "trace_records": spans,
+            "heartbeats": beats,
+            "policy": "best_of_2",
+            "config": (
+                "headline config (kfused_comp k=4) wall-timed with "
+                "tracing + heartbeat live vs untraced, net of compile; "
+                "overhead bar <= 2%"
+            ),
+        }
+    except Exception:
+        print("telemetry sub-benchmark failed:", file=sys.stderr)
+        traceback.print_exc()
+        return {"error": "failed; see stderr"}
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def _ensemble_rows(interp, scheme="standard", path="pallas", k=1,
                    tag="ensemble", n=256, steps=100):
     """Serving rows: aggregate throughput and per-request latency through
@@ -360,9 +436,15 @@ def main() -> int:
         sharded_kfused,
     )
 
+    import os
+
     dev = jax.devices()[0]
-    n = 512
-    steps = 1000
+    # Chip default N=512/1000 (the headline contract).  The env knobs
+    # exist for the CI nightly artifact leg, which captures the perf-
+    # trajectory SHAPE on a CPU runner where the chip config would take
+    # hours; the emitted config block records whatever actually ran.
+    n = int(os.environ.get("WAVETPU_BENCH_N", "512"))
+    steps = int(os.environ.get("WAVETPU_BENCH_STEPS", "1000"))
     problem = Problem(N=n, timesteps=steps)
     on_tpu = jax.default_backend() == "tpu"
     interp = not on_tpu
@@ -574,6 +656,9 @@ def main() -> int:
         ),
     }
 
+    # Telemetry overhead: the headline config with tracing + heartbeat
+    # live; the observability layer's <= 2% acceptance bar.
+    subs["telemetry"] = _telemetry_row(problem, head, interp)
     # Supervised headline: the flagship config under run/supervisor.py
     # (periodic checkpoints + per-chunk watchdog) so robustness features
     # cannot silently regress perf - overhead is recorded as a % of the
@@ -652,6 +737,9 @@ def main() -> int:
         "kfused_varc_gcells_per_s": varc_row.get("gcells_per_s"),
         "supervised_overhead_pct": subs["supervised"].get(
             "overhead_pct_vs_headline"
+        ),
+        "telemetry_overhead_pct": subs["telemetry"].get(
+            "telemetry_overhead_pct_vs_headline"
         ),
         "ensemble_batch8_gcells_per_s": subs["ensemble"].get(
             "batch8", {}

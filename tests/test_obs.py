@@ -1,0 +1,444 @@
+"""Unified-telemetry contracts: registry, tracing, trace-report, and the
+acceptance drill - a supervised multi-chunk run whose trace's chunk
+boundaries match the checkpoint rotation steps on disk.
+
+The Prometheus exposition is validated with `parse_prometheus`, a
+minimal line parser shared with tests/test_serve.py (which checks the
+HTTP surface); here it pins the renderer itself: sample names, label
+escaping, histogram triplets, and text/JSON agreement on shared state.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from wavetpu.obs import report as obs_report
+from wavetpu.obs import telemetry, tracing
+from wavetpu.obs.registry import MetricsRegistry, get_registry
+
+
+def parse_prometheus(text):
+    """Minimal exposition-format parser: {sample_name_with_labels: float}
+    plus {family: type}.  Raises on malformed lines, so using it IS the
+    validity assertion."""
+    samples, types = {}, {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            _, _, family, kind = line.split(" ", 3)
+            assert kind in ("counter", "gauge", "histogram"), line
+            types[family] = kind
+            continue
+        assert not line.startswith("#"), f"unknown comment {line!r}"
+        name, _, value = line.rpartition(" ")
+        assert name, f"malformed sample line {line!r}"
+        samples[name] = float(value.replace("+Inf", "inf"))
+    return samples, types
+
+
+# ---- registry ----
+
+
+class TestRegistry:
+    def test_counter_gauge_basics(self):
+        r = MetricsRegistry()
+        c = r.counter("wavetpu_t_total", "things")
+        c.inc()
+        c.inc(2.5)
+        assert c.value() == 3.5
+        with pytest.raises(ValueError, match="decrease"):
+            c.inc(-1)
+        g = r.gauge("wavetpu_t_gauge", "level")
+        g.set(7)
+        g.dec(2)
+        assert g.value() == 5
+
+    def test_labels_and_reregistration(self):
+        r = MetricsRegistry()
+        c = r.counter("wavetpu_l_total", "labeled", ("path",))
+        c.inc(path="roll")
+        c.inc(3, path="kfused")
+        assert c.value(path="roll") == 1
+        assert c.value(path="kfused") == 3
+        # idempotent re-registration returns the same child
+        assert r.counter("wavetpu_l_total", "labeled", ("path",)) is c
+        # type or labelname mismatch is a loud error, not a silent fork
+        with pytest.raises(ValueError, match="already registered"):
+            r.gauge("wavetpu_l_total", "labeled", ("path",))
+        with pytest.raises(ValueError, match="already registered"):
+            r.counter("wavetpu_l_total", "labeled", ("other",))
+        # wrong labels at call time
+        with pytest.raises(ValueError, match="wants labels"):
+            c.inc(nope="x")
+
+    def test_histogram_buckets_cumulative(self):
+        r = MetricsRegistry()
+        h = r.histogram("wavetpu_h_seconds", "lat", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        samples, types = parse_prometheus(r.render_prometheus())
+        assert types["wavetpu_h_seconds"] == "histogram"
+        assert samples['wavetpu_h_seconds_bucket{le="0.1"}'] == 1
+        assert samples['wavetpu_h_seconds_bucket{le="1"}'] == 2
+        assert samples['wavetpu_h_seconds_bucket{le="+Inf"}'] == 3
+        assert samples["wavetpu_h_seconds_count"] == 3
+        assert samples["wavetpu_h_seconds_sum"] == pytest.approx(5.55)
+
+    def test_label_escaping(self):
+        r = MetricsRegistry()
+        c = r.counter("wavetpu_esc_total", "esc", ("src",))
+        c.inc(src='a"b\\c\nd')
+        text = r.render_prometheus()
+        assert 'wavetpu_esc_total{src="a\\"b\\\\c\\nd"} 1' in text
+        # the escaped line round-trips through the parser
+        samples, _ = parse_prometheus(text)
+        assert samples['wavetpu_esc_total{src="a\\"b\\\\c\\nd"}'] == 1
+
+    def test_snapshot_and_text_agree(self):
+        r = MetricsRegistry()
+        r.counter("wavetpu_a_total", "a").inc(4)
+        r.gauge("wavetpu_b", "b").set(2.5)
+        snap = r.snapshot()
+        samples, _ = parse_prometheus(r.render_prometheus())
+        assert snap["wavetpu_a_total"] == samples["wavetpu_a_total"] == 4
+        assert snap["wavetpu_b"] == samples["wavetpu_b"] == 2.5
+
+    def test_snapshot_is_one_consistent_cut(self):
+        # A writer bumps two counters under the registry lock; no
+        # snapshot may ever observe them out of step.
+        r = MetricsRegistry()
+        a = r.counter("wavetpu_pair_a_total", "a")
+        b = r.counter("wavetpu_pair_b_total", "b")
+        stop = threading.Event()
+
+        def writer():
+            while not stop.is_set():
+                with r.lock:
+                    a.inc()
+                    b.inc()
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        try:
+            for _ in range(200):
+                snap = r.snapshot()
+                assert snap["wavetpu_pair_a_total"] == \
+                    snap["wavetpu_pair_b_total"]
+        finally:
+            stop.set()
+            t.join()
+
+
+# ---- tracing ----
+
+
+class TestTracing:
+    def test_disabled_tracer_is_noop(self):
+        tracing.disable()
+        assert tracing.begin_span("x") is None
+        tracing.end_span(None)
+        tracing.event("x", a=1)  # no crash, nothing written
+        with tracing.span("x", a=1) as attrs:
+            attrs["b"] = 2  # throwaway dict
+
+    def test_spans_nest_and_link(self, tmp_path):
+        path = str(tmp_path / "trace.jsonl")
+        tracing.configure(path)
+        try:
+            with tracing.span("outer", who="parent"):
+                with tracing.span("inner") as attrs:
+                    attrs["found"] = 42
+                tracing.event("ping", n=1)
+        finally:
+            tracing.disable()
+        recs = [json.loads(line) for line in open(path)]
+        by_kind = {r["kind"]: r for r in recs}
+        # inner closes first (JSONL is emission-ordered)
+        assert [r["kind"] for r in recs] == ["inner", "ping", "outer"]
+        assert by_kind["inner"]["parent_id"] == by_kind["outer"]["span_id"]
+        assert by_kind["ping"]["parent_id"] == by_kind["outer"]["span_id"]
+        assert by_kind["inner"]["attrs"]["found"] == 42
+        assert by_kind["outer"]["attrs"]["who"] == "parent"
+        assert by_kind["outer"]["dur_s"] >= by_kind["inner"]["dur_s"]
+        assert by_kind["ping"]["type"] == "event"
+
+    def test_parenthood_is_thread_local(self, tmp_path):
+        path = str(tmp_path / "trace.jsonl")
+        tracing.configure(path)
+        try:
+            with tracing.span("main-span"):
+                done = threading.Event()
+
+                def other():
+                    with tracing.span("other-thread"):
+                        pass
+                    done.set()
+
+                threading.Thread(target=other).start()
+                assert done.wait(10)
+        finally:
+            tracing.disable()
+        recs = {r["kind"]: r for r in
+                (json.loads(line) for line in open(path))}
+        assert recs["other-thread"]["parent_id"] is None
+
+    def test_attr_named_kind_allowed(self, tmp_path):
+        path = str(tmp_path / "trace.jsonl")
+        tracing.configure(path)
+        try:
+            tracing.event("checkpoint.save", kind="single", step=3)
+        finally:
+            tracing.disable()
+        (rec,) = [json.loads(line) for line in open(path)]
+        assert rec["kind"] == "checkpoint.save"
+        assert rec["attrs"]["kind"] == "single"
+
+    def test_end_span_idempotent(self, tmp_path):
+        """A crash-path end_span can race the normal end on the same
+        handle (supervisor's except handler after a chunk span already
+        closed); the second end must be a silent no-op - one record, no
+        KeyError masking the original exception, clean parent stack."""
+        path = str(tmp_path / "trace.jsonl")
+        tracing.configure(path)
+        try:
+            h = tracing.begin_span("x", a=1)
+            tracing.end_span(h, ok=True)
+            tracing.end_span(h, error="boom")  # must not raise or emit
+            assert tracing.get_tracer().current_span_id() is None
+        finally:
+            tracing.disable()
+        (rec,) = [json.loads(line) for line in open(path)]
+        assert rec["attrs"] == {"a": 1, "ok": True}
+
+
+# ---- trace-report ----
+
+
+def _synthetic_trace(tmp_path):
+    recs = [
+        {"type": "span", "kind": "serve.request", "span_id": "p-1",
+         "parent_id": None, "t_start": 10.0, "dur_s": 0.50,
+         "attrs": {"request_id": "p-9", "status": 200}},
+        {"type": "span", "kind": "serve.execute", "span_id": "p-3",
+         "parent_id": "p-2", "t_start": 10.1, "dur_s": 0.30,
+         "attrs": {"warm": True}},
+        {"type": "span", "kind": "serve.batch", "span_id": "p-2",
+         "parent_id": None, "t_start": 10.05, "dur_s": 0.40,
+         "attrs": {"request_ids": ["p-9"], "occupancy": 2}},
+        {"type": "span", "kind": "serve.request", "span_id": "p-4",
+         "parent_id": None, "t_start": 11.0, "dur_s": 0.10,
+         "attrs": {"request_id": "p-8", "status": 400}},
+        {"type": "event", "kind": "supervisor.retry", "span_id": "p-5",
+         "parent_id": None, "t_start": 12.0, "attrs": {"step": 4}},
+    ]
+    path = tmp_path / "t.jsonl"
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+        f.write("not json\n")  # mid-write tail must not be fatal
+    return str(path)
+
+
+class TestTraceReport:
+    def test_summarize(self, tmp_path):
+        records = obs_report.load_trace(_synthetic_trace(tmp_path))
+        s = obs_report.summarize(records)
+        assert s["spans"]["serve.request"]["count"] == 2
+        assert s["spans"]["serve.request"]["total_s"] == pytest.approx(0.6)
+        assert s["spans"]["serve.request"]["p95_ms"] == pytest.approx(500.0)
+        assert s["events"] == {"supervisor.retry": 1}
+        text = obs_report.format_summary(s)
+        assert "serve.request" in text and "p95_ms" in text
+
+    def test_request_view_joins_batch_and_descendants(self, tmp_path):
+        records = obs_report.load_trace(_synthetic_trace(tmp_path))
+        view = obs_report.request_view(records, "p-9")
+        kinds = [r["kind"] for r in view]
+        # the request span, the batch tagged with its id, AND the
+        # batch's untagged execute child - the other request excluded
+        assert kinds == ["serve.request", "serve.batch", "serve.execute"]
+        text = obs_report.format_request_view(view, "p-9")
+        assert "serve.execute" in text
+
+    def test_cli_subcommand(self, tmp_path, capsys):
+        from wavetpu.cli import main
+
+        path = _synthetic_trace(tmp_path)
+        assert main(["trace-report", path]) == 0
+        out = capsys.readouterr().out
+        assert "serve.batch" in out
+        assert main(["trace-report", path, "--request", "p-9"]) == 0
+        assert "critical path of request p-9" in capsys.readouterr().out
+        assert main(["trace-report"]) == 2
+        assert main(["trace-report", str(tmp_path / "missing.jsonl")]) == 2
+
+
+# ---- telemetry dir ----
+
+
+class TestTelemetry:
+    def test_heartbeat_and_prom_files(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.counter("wavetpu_beats_total", "x").inc(5)
+        tel = telemetry.start(str(tmp_path), registry=reg, interval=60.0)
+        try:
+            tracing.event("hello", n=1)
+        finally:
+            tel.stop()
+        beats = [json.loads(line)
+                 for line in open(tmp_path / "heartbeat.jsonl")]
+        assert beats  # stop() always writes a final beat
+        assert beats[-1]["metrics"]["wavetpu_beats_total"] == 5
+        samples, _ = parse_prometheus(open(tmp_path / "metrics.prom").read())
+        assert samples["wavetpu_beats_total"] == 5
+        recs = [json.loads(line) for line in open(tmp_path / "trace.jsonl")]
+        assert recs[0]["kind"] == "hello"
+        # tracer is torn down with the handle
+        assert not tracing.enabled()
+
+
+# ---- solver counters ----
+
+
+class TestSolveCounters:
+    def test_leapfrog_solve_increments_registry(self, small_problem):
+        from wavetpu.solver import leapfrog
+
+        reg = get_registry()
+        c = reg.counter("wavetpu_solves_total",
+                        "completed solve entry points", ("path",))
+        before = c.value(path="leapfrog")
+        cells = reg.counter(
+            "wavetpu_solve_cells_total",
+            "cell updates marched ((N+1)^3 per layer)", ("path",),
+        )
+        cells_before = cells.value(path="leapfrog")
+        leapfrog.solve(small_problem)
+        assert c.value(path="leapfrog") == before + 1
+        expected = (
+            small_problem.cells_per_step * small_problem.timesteps
+        )
+        assert cells.value(path="leapfrog") - cells_before == \
+            pytest.approx(expected)
+
+
+# ---- acceptance: supervised multi-chunk run under --telemetry-dir ----
+
+
+class TestSupervisedTelemetry:
+    def test_chunk_spans_match_checkpoint_rotation(self, tmp_path):
+        """The ISSUE's acceptance drill: a supervised multi-chunk run
+        with telemetry on emits chunk spans whose boundaries equal the
+        checkpoint steps (spans AND rotation entries on disk), and
+        trace-report summarizes them."""
+        from wavetpu.cli import main
+        from wavetpu.run.supervisor import _entry_step
+
+        tel = tmp_path / "tel"
+        ckpt = tmp_path / "ckpt"
+        rc = main([
+            "16", "1", "1", "1", "1", "1", "12", "--backend", "single",
+            "--ckpt-every", "4", "--ckpt-dir", str(ckpt),
+            "--telemetry-dir", str(tel), "--out-dir", str(tmp_path),
+        ])
+        assert rc == 0
+        recs = [json.loads(line) for line in open(tel / "trace.jsonl")]
+        chunk_ends = sorted(
+            r["attrs"]["end"] for r in recs
+            if r["kind"] == "supervisor.chunk"
+        )
+        ckpt_steps = sorted(
+            r["attrs"]["step"] for r in recs
+            if r["kind"] == "supervisor.checkpoint"
+        )
+        # ckpt_every=4 over 12 layers: first chunk marches 1+4, then 4+3
+        assert chunk_ends == [5, 9, 12]
+        assert ckpt_steps == chunk_ends
+        # ...and the spans agree with the rotation on disk (keep-2 GC
+        # leaves the newest two entries).
+        disk_steps = sorted(
+            s for e in os.listdir(ckpt)
+            if (s := _entry_step(e)) is not None
+        )
+        assert disk_steps == ckpt_steps[-2:]
+        # every chunk span nests under the one supervisor.march span
+        march = [r for r in recs if r["kind"] == "supervisor.march"]
+        assert len(march) == 1
+        assert march[0]["attrs"]["status"] == "complete"
+        for r in recs:
+            if r["kind"] == "supervisor.chunk":
+                assert r["parent_id"] == march[0]["span_id"]
+        # io-layer events carry byte counts
+        saves = [r for r in recs if r["kind"] == "checkpoint.save"]
+        assert saves and all(r["attrs"]["bytes"] > 0 for r in saves)
+        # heartbeat carries the supervisor counters
+        beats = [json.loads(line) for line in open(tel / "heartbeat.jsonl")]
+        assert beats[-1]["metrics"]["wavetpu_supervisor_checkpoints_total"] \
+            >= 3
+        # and trace-report summarizes the trace
+        s = obs_report.summarize(recs)
+        assert s["spans"]["supervisor.chunk"]["count"] == 3
+        assert "supervisor.checkpoint" in s["spans"]
+        assert not tracing.enabled()  # CLI tore telemetry down
+
+    def test_seed_checkpoint_counted(self, small_problem, tmp_path):
+        """An injected-state resume into an empty rotation root seeds
+        the rotation with the caller's checkpoint; the registry counter
+        must count that entry like SupervisedResult.checkpoints_written
+        (else the counters-vs-rotation audit reports a false mismatch)."""
+        from wavetpu.io import checkpoint
+        from wavetpu.run import supervisor as sup
+
+        c = get_registry().counter(
+            "wavetpu_supervisor_checkpoints_total",
+            "rotation entries written",
+        )
+        r = sup.supervise(
+            small_problem, sup.PathSpec(),
+            sup.SupervisorOptions(ckpt_every=3,
+                                  ckpt_dir=str(tmp_path / "rot")),
+        )
+        _, u_prev, u_cur, step = checkpoint.load_checkpoint(
+            r.checkpoint_path
+        )
+        before = c.value()
+        r2 = sup.supervise(
+            small_problem, sup.PathSpec(),
+            sup.SupervisorOptions(ckpt_every=3,
+                                  ckpt_dir=str(tmp_path / "rot2")),
+            state=(u_prev, u_cur), start_step=step,
+        )
+        assert r2.checkpoints_written >= 2  # the seed + the final save
+        assert c.value() - before == r2.checkpoints_written
+
+    def test_crash_mid_dispatch_stops_telemetry(self, tmp_path,
+                                                monkeypatch):
+        """An exception inside the solve dispatch must still emit the
+        open cli.solve span, stop the heartbeat daemon, and unbind the
+        process tracer - in-process callers (this test) never reach the
+        atexit net, and a later run must not inherit a stale tracer."""
+        from wavetpu.cli import main
+        from wavetpu.solver import leapfrog
+
+        def boom(*a, **kw):
+            raise RuntimeError("injected mid-dispatch failure")
+
+        monkeypatch.setattr(leapfrog, "solve", boom)
+        tel = tmp_path / "tel"
+        with pytest.raises(RuntimeError, match="injected"):
+            main([
+                "16", "1", "1", "1", "1", "1", "10", "--backend",
+                "single", "--kernel", "roll", "--telemetry-dir",
+                str(tel), "--out-dir", str(tmp_path),
+            ])
+        assert not tracing.enabled()
+        recs = [json.loads(line) for line in open(tel / "trace.jsonl")]
+        (span,) = [r for r in recs if r["kind"] == "cli.solve"]
+        assert span["attrs"]["aborted"] is True
+        # the final heartbeat landed too
+        assert (tel / "heartbeat.jsonl").exists()

@@ -24,9 +24,11 @@ from wavetpu.serve.api import _c2_preset, build_server, parse_solve_request
 from wavetpu.serve.engine import ProgramKey, ServeEngine
 from wavetpu.serve.scheduler import (
     DynamicBatcher,
+    QueueFullError,
     ServeMetrics,
     SolveRequest,
 )
+from tests.test_obs import parse_prometheus
 
 
 def _bitwise(a, b):
@@ -435,6 +437,128 @@ class TestDrain:
             f2.result(0)
 
 
+class TestBoundedQueue:
+    """Bounded request queue with 429 backpressure (ROADMAP serving-
+    hardening item): submit() raises QueueFullError once max_queue
+    requests are submitted-but-not-executing; depth and rejections are
+    exposed via the registry and /metrics."""
+
+    def test_submit_rejects_when_full(self):
+        class _StuckEngine(_FakeEngine):
+            def __init__(self):
+                super().__init__()
+                self.release = threading.Event()
+
+            def solve(self, *a, **k):
+                self.release.wait(30)
+                return super().solve(*a, **k)
+
+        eng = _StuckEngine()
+        metrics = ServeMetrics()
+        b = DynamicBatcher(eng, metrics=metrics, max_wait=30.0,
+                           max_batch=1, max_queue=2)
+        p = Problem(N=8, timesteps=3)
+        try:
+            # First fills the (max_batch=1) in-flight batch; the worker
+            # takes it off the queue, so keep stuffing until depth
+            # sticks at the bound, then the next submit must 429.
+            futs = [b.submit(_req(p, phase=1.0 + i)) for i in range(2)]
+            with pytest.raises(QueueFullError, match="queue full"):
+                for i in range(8):
+                    futs.append(b.submit(_req(p, phase=10.0 + i)))
+            snap = metrics.snapshot()
+            assert snap["rejected_total"] >= 1
+            assert snap["queue_depth"] >= 1
+        finally:
+            eng.release.set()
+            b.close(timeout=10.0, drain=True)
+
+    def test_zero_max_queue_rejects_everything(self):
+        b = DynamicBatcher(_FakeEngine(), max_wait=0.01, max_queue=0)
+        p = Problem(N=8, timesteps=3)
+        try:
+            with pytest.raises(QueueFullError):
+                b.submit(_req(p))
+        finally:
+            b.close()
+
+    def test_unbounded_by_default(self):
+        b = DynamicBatcher(_FakeEngine(), max_wait=0.2)
+        assert b.max_queue is None
+        p = Problem(N=8, timesteps=3)
+        futs = [b.submit(_req(p, phase=1.0 + i)) for i in range(16)]
+        for f in futs:
+            f.result(10)
+        b.close()
+
+    def test_depth_returns_to_zero_after_service(self):
+        metrics = ServeMetrics()
+        b = DynamicBatcher(_FakeEngine(), metrics=metrics, max_wait=0.05)
+        p = Problem(N=8, timesteps=3)
+        b.submit(_req(p)).result(10)
+        b.close()
+        assert metrics.snapshot()["queue_depth"] == 0
+
+
+class TestMetricsRegistryIntegration:
+    """ServeMetrics writes through the registry: the JSON snapshot keeps
+    its historical fields while the same cut renders as Prometheus text,
+    and snapshot() holds ONE lock across everything it reads."""
+
+    def test_snapshot_fields_preserved_and_extended(self):
+        m = ServeMetrics()
+        m.observe_request()
+        m.observe_response(True)
+        m.observe_batch(occupancy=3, batched=True, cells=1e9,
+                        solve_seconds=0.5, batch_size=4)
+        m.observe_latency(0.1)
+        snap = m.snapshot()
+        # historical fields, exact names and derivations
+        assert snap["requests_total"] == 1
+        assert snap["responses_ok"] == 1
+        assert snap["responses_error"] == 0
+        assert snap["batches_total"] == 1
+        assert snap["batch_occupancy_mean"] == 3.0
+        assert snap["batch_occupancy_max"] == 3
+        assert snap["fallback_batches"] == 0
+        assert snap["latency_p50_ms"] == 100.0
+        assert snap["aggregate_gcells_per_s"] == 2.0
+        # new observability fields
+        assert snap["queue_depth"] == 0
+        assert snap["rejected_total"] == 0
+        assert snap["padding_lanes_total"] == 1
+        assert snap["last_batch_age_seconds"] is not None
+
+    def test_json_and_text_views_agree(self):
+        m = ServeMetrics()
+        for _ in range(3):
+            m.observe_request()
+        m.observe_response(True)
+        m.observe_response(False)
+        m.observe_batch(occupancy=2, batched=False, cells=2e9,
+                        solve_seconds=1.0, batch_size=2)
+        m.observe_latency(0.2)
+        snap = m.snapshot()
+        samples, types = parse_prometheus(m.registry.render_prometheus())
+        assert types["wavetpu_serve_requests_total"] == "counter"
+        assert samples["wavetpu_serve_requests_total"] == \
+            snap["requests_total"] == 3
+        assert samples['wavetpu_serve_responses_total{status="ok"}'] == \
+            snap["responses_ok"] == 1
+        assert samples['wavetpu_serve_responses_total{status="error"}'] \
+            == snap["responses_error"] == 1
+        assert samples["wavetpu_serve_batches_total"] == \
+            snap["batches_total"] == 1
+        assert samples["wavetpu_serve_fallback_batches_total"] == \
+            snap["fallback_batches"] == 1
+        # histogram triplet for the latency distribution
+        assert samples["wavetpu_serve_request_seconds_count"] == 1
+        assert samples["wavetpu_serve_request_seconds_sum"] == \
+            pytest.approx(0.2)
+        assert samples['wavetpu_serve_request_seconds_bucket{le="+Inf"}'] \
+            == 1
+
+
 # ---- request parsing ----
 
 class TestParse:
@@ -592,6 +716,101 @@ class TestHTTP:
         assert code == 200
         assert body["status"] == "ok"
 
+    def test_healthz_idle_vs_wedged_fields(self, server):
+        # The load-balancer discriminator fields: uptime, draining, and
+        # last-batch age (null while idle, a number after traffic).
+        base, state = server
+        code, body = _get(base, "/healthz")
+        assert code == 200
+        assert body["uptime_seconds"] >= 0
+        assert body["draining"] is False
+        assert body["last_batch_age_seconds"] is None
+        _post(base, {"N": 8, "timesteps": 4})
+        code, body = _get(base, "/healthz")
+        assert body["last_batch_age_seconds"] is not None
+        assert body["last_batch_age_seconds"] >= 0
+        state.draining = True
+        try:
+            code, body = _get(base, "/healthz")
+            assert body["draining"] is True
+        finally:
+            state.draining = False
+
+    def test_metrics_prometheus_text_negotiated(self, server):
+        base, state = server
+        _post(base, {"N": 8, "timesteps": 4})
+        req = urllib.request.Request(
+            base + "/metrics", headers={"Accept": "text/plain"}
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        samples, types = parse_prometheus(text)
+        assert samples["wavetpu_serve_requests_total"] >= 1
+        assert types["wavetpu_serve_request_seconds"] == "histogram"
+        assert samples["wavetpu_serve_request_seconds_count"] >= 1
+        # engine metrics share the server registry (build_server wiring)
+        assert samples['wavetpu_program_cache_events_total{event="miss"}'] \
+            >= 1
+        # the same cut agrees with the JSON view
+        code, snap = _get(base, "/metrics")
+        assert code == 200
+        assert snap["requests_total"] == \
+            samples["wavetpu_serve_requests_total"]
+        # default Accept still gets the historical JSON shape
+        assert "program_cache" in snap
+
+    def test_request_and_batch_spans_join_on_request_id(
+        self, server, tmp_path
+    ):
+        from wavetpu.obs import report as obs_report
+        from wavetpu.obs import tracing
+
+        base, _ = server
+        path = str(tmp_path / "trace.jsonl")
+        tracing.configure(path)
+        try:
+            code, body = _post(base, {"N": 8, "timesteps": 4})
+            assert code == 200
+        finally:
+            tracing.disable()
+        recs = [json.loads(line) for line in open(path)]
+        reqs = [r for r in recs if r["kind"] == "serve.request"]
+        batches = [r for r in recs if r["kind"] == "serve.batch"]
+        assert len(reqs) == 1 and len(batches) == 1
+        rid = reqs[0]["attrs"]["request_id"]
+        assert rid in batches[0]["attrs"]["request_ids"]
+        assert reqs[0]["attrs"]["status"] == 200
+        assert batches[0]["attrs"]["padding_lanes"] == 0
+        # execute (and on first contact compile) spans nest under batch
+        execs = [r for r in recs if r["kind"] == "serve.execute"]
+        assert execs and execs[0]["parent_id"] == batches[0]["span_id"]
+        # trace-report stitches the critical path from the id
+        view = obs_report.request_view(recs, rid)
+        kinds = {r["kind"] for r in view}
+        assert {"serve.request", "serve.batch", "serve.execute"} <= kinds
+
+    def test_queue_full_returns_429(self):
+        httpd, state = build_server(
+            port=0, max_wait=0.1, default_kernel="roll",
+            interpret=True, max_queue=0,
+        )
+        thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+        thread.start()
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            code, body = _post(base, {"N": 8, "timesteps": 4})
+            assert code == 429
+            assert "queue full" in body["error"]
+            code, snap = _get(base, "/metrics")
+            assert snap["rejected_total"] == 1
+            assert snap["responses_error"] == 1
+        finally:
+            httpd.shutdown()
+            state.batcher.close()
+            httpd.server_close()
+
     def test_draining_returns_503(self, server):
         base, state = server
         state.draining = True
@@ -683,6 +902,38 @@ class TestCLI:
         from wavetpu.cli import main
 
         assert main(["serve", "--frobnicate", "1"]) == 2
+
+    def test_serve_rejects_malformed_warmup(self, capsys):
+        """Malformed --warmup values are usage errors (exit 2 with the
+        usage line, like every other numeric flag), not tracebacks."""
+        from wavetpu.serve.api import main
+
+        assert main(["--warmup", "8x4"]) == 2
+        assert "usage" in capsys.readouterr().err
+        assert main(["--warmup", "8,4,2,9"]) == 2
+        assert "--warmup wants" in capsys.readouterr().err
+
+    def test_serve_main_crash_stops_telemetry(self, tmp_path,
+                                              monkeypatch, capsys):
+        """A crash between server build and serve start (warmup compile
+        failure here) must not leak the heartbeat daemon or leave the
+        process tracer bound for an in-process caller."""
+        from wavetpu.obs import tracing
+        from wavetpu.serve.api import main
+
+        def boom(self, *a, **kw):
+            raise RuntimeError("injected warmup failure")
+
+        monkeypatch.setattr(ServeEngine, "warmup", boom)
+        with pytest.raises(RuntimeError, match="injected"):
+            main([
+                "--port", "0", "--kernel", "roll",
+                "--warmup", "8,4",
+                "--telemetry-dir", str(tmp_path / "tel"),
+            ])
+        assert not tracing.enabled()
+        # the final heartbeat landed on the way out
+        assert (tmp_path / "tel" / "heartbeat.jsonl").exists()
 
     def test_program_key_shape(self):
         p = Problem(N=8, timesteps=3)

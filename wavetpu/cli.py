@@ -139,6 +139,18 @@ pick at runtime):
                                     the analytic solution is |u| <= 1, so
                                     the default only trips real blowups)
   --no-watchdog                     disable the per-chunk health check
+  --telemetry-dir DIR               unified telemetry (wavetpu/obs/,
+                                    docs/observability.md): structured
+                                    JSONL spans into DIR/trace.jsonl
+                                    (supervisor chunks, health checks,
+                                    checkpoint writes - aligned with
+                                    --profile device traces via
+                                    jax.profiler.TraceAnnotation) plus
+                                    periodic registry snapshots
+                                    (DIR/heartbeat.jsonl to tail,
+                                    DIR/metrics.prom to scrape);
+                                    summarize with `wavetpu trace-report
+                                    DIR/trace.jsonl`
 
 Exit codes (docs/robustness.md): 0 complete; 2 usage or checkpoint-load
 error; 3 preempted but checkpointed (requeue + --resume); 4 numerical-
@@ -147,7 +159,10 @@ Non-zero supervised exits print `resumable checkpoint: PATH`.
 
 Subcommands: `wavetpu serve [...]` starts the batched-inference HTTP
 front end (wavetpu/serve/api.py, also installed as `wavetpu-serve`;
-endpoint contract in docs/serving.md).  `wavetpu --version` prints the
+endpoint contract in docs/serving.md).  `wavetpu trace-report
+TRACE.jsonl [--kind K] [--request ID]` summarizes a --telemetry-dir
+span trace (per-kind count/total/p50/p95; critical-path view of one
+request - wavetpu/obs/report.py).  `wavetpu --version` prints the
 package version (both entry points accept it).
 """
 
@@ -165,6 +180,7 @@ _KNOWN_FLAGS = (
     "kernel", "overlap", "scheme", "distributed", "profile",
     "fuse-steps", "debug-nans", "v-dtype", "c2-field",
     "ckpt-every", "ckpt-dir", "retries", "max-amp", "no-watchdog",
+    "telemetry-dir",
 )
 _VALUELESS = (
     "no-errors", "phase-timing", "overlap", "distributed", "debug-nans",
@@ -222,6 +238,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from wavetpu.serve import api as serve_api
 
         return serve_api.main(argv[1:])
+    if argv and argv[0] == "trace-report":
+        # Telemetry trace summarizer (stdlib-only; never touches jax).
+        from wavetpu.obs import report as obs_report
+
+        return obs_report.main(argv[1:])
     if "--version" in argv:
         from wavetpu import __version__
 
@@ -344,7 +365,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(f"error: {e}", file=sys.stderr)
         print(
             "usage: wavetpu N Np Lx Ly Lz [T] [timesteps] | "
-            "wavetpu serve [...] | wavetpu --version\n"
+            "wavetpu serve [...] | wavetpu trace-report TRACE.jsonl | "
+            "wavetpu --version\n"
             "       wavetpu N Np Lx Ly Lz [T] [timesteps] "
             "[--backend auto|single|sharded] [--mesh MX,MY,MZ] "
             "[--dtype f32|f64|bf16] [--kernel auto|roll|pallas] "
@@ -354,7 +376,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "[--debug-nans] [--distributed] [--stop-step S] "
             "[--save-state PATH] [--resume PATH] "
             "[--ckpt-every S] [--ckpt-dir DIR] [--retries N] "
-            "[--max-amp X] [--no-watchdog] "
+            "[--max-amp X] [--no-watchdog] [--telemetry-dir DIR] "
             "[--out-dir DIR] [--platform NAME]",
             file=sys.stderr,
         )
@@ -682,525 +704,577 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # op-level picture.
         jax.profiler.start_trace(profile_dir)
 
-    if backend == "sharded" and resume_is_sharded:
-        # Shared load for both sharded resume paths (1-step and k-fused).
-        from wavetpu.io import checkpoint as _ckpt
+    from wavetpu.obs import tracing as _tracing
 
-        try:
-            (problem, _u_prev0, _u_cur0, _start, _ck_mesh,
-             _ck_scheme, _ck_aux) = (
-                _ckpt.load_sharded_checkpoint(flags["resume"])
-            )
-        except Exception as e:
-            # Missing/truncated shard files, step/meta mismatch from a
-            # mid-save preemption, or too few devices for the stored
-            # mesh - same clean exit as a corrupt .npz.
-            print(f"error: cannot load checkpoint: {e}", file=sys.stderr)
-            return 2
-        resume_dtype = (
-            dtype if "dtype" in flags else jnp.dtype(_u_cur0.dtype)
-        )
+    telemetry = None
+    if "telemetry-dir" in flags and is_main:
+        # Unified telemetry: spans to DIR/trace.jsonl + heartbeat
+        # registry snapshots (docs/observability.md).  Spans open
+        # jax.profiler.TraceAnnotations, so with --profile the
+        # application structure lands inside the device trace too.
+        from wavetpu.obs import telemetry as _telemetry
 
-    sup_out = None
-    if supervised:
-        # Supervised solve (run/supervisor.py): every solver path below
-        # has a supervised twin - chunked march through cached chunk
-        # programs, rotating checkpoints, watchdog, signal handling.
-        from wavetpu.run import supervisor as _sup
+        telemetry = _telemetry.start(flags["telemetry-dir"])
+        say(f"telemetry: {flags['telemetry-dir']}")
+    solve_span = _tracing.begin_span(
+        "cli.solve", backend=backend, scheme=scheme, kernel=kernel,
+        fuse_steps=fuse_steps, n=problem.N,
+        timesteps=problem.timesteps, supervised=supervised,
+        resumed="resume" in flags,
+    )
 
-        ckpt_dir = flags.get("ckpt-dir") or rotation_root
-        if not ckpt_dir:
-            print(
-                "error: --ckpt-every needs --ckpt-dir DIR (or --resume "
-                "of an existing rotation root)",
-                file=sys.stderr,
-            )
-            return 2
-        spec_vdtype = None
-        spec_carry = True
-        sup_state = None
-        sup_start = None
-        sup_mesh = mesh_shape
-        sup_dtype = dtype
-        if scheme == "compensated" and fuse_steps > 1 and \
-                "resume" not in flags:
-            v_bf16 = flags.get("v-dtype") == "bf16"
-            spec_vdtype = jnp.bfloat16 if v_bf16 else None
-            spec_carry = not v_bf16
-        def _comp_resume_state(u_cur0, aux, st_dtype):
-            # Shared bf16-increment detection: a bf16 v stream beside a
-            # non-bf16 carrier marks the carry-less increment form
-            # (k-fused only); the sidecar must record the mode that ran.
-            _v, _c = aux
-            inc = (
-                fuse_steps > 1
-                and jnp.dtype(_v.dtype) == jnp.bfloat16
-                and jnp.dtype(st_dtype) != jnp.bfloat16
-            )
-            if inc:
-                flags["v-dtype"] = "bf16"
-            return (
-                (u_cur0, _v, None if inc else _c),
-                jnp.bfloat16 if inc else None,
-                not inc,
-            )
+    def _abort_telemetry():
+        # Error exits after telemetry started must still emit the open
+        # span and the final heartbeat (atexit only covers process
+        # death, not in-process callers like the tests).
+        _tracing.end_span(solve_span, aborted=True)
+        if telemetry is not None:
+            telemetry.stop()
 
-        if "resume" in flags:
-            if resume_is_sharded:
-                sup_dtype = resume_dtype
-                sup_mesh = _ck_mesh
-                sup_start = _start
-                if scheme == "compensated":
-                    sup_state, spec_vdtype, spec_carry = (
-                        _comp_resume_state(_u_cur0, _ck_aux, sup_dtype)
-                    )
-                else:
-                    sup_state = (_u_prev0, _u_cur0)
-            else:
-                u_prev0, u_cur0, sup_start = resume_state
-                sup_dtype = (
-                    dtype if "dtype" in flags
-                    else jnp.dtype(u_cur0.dtype)
+    try:
+        if backend == "sharded" and resume_is_sharded:
+            # Shared load for both sharded resume paths (1-step and k-fused).
+            from wavetpu.io import checkpoint as _ckpt
+
+            try:
+                (problem, _u_prev0, _u_cur0, _start, _ck_mesh,
+                 _ck_scheme, _ck_aux) = (
+                    _ckpt.load_sharded_checkpoint(flags["resume"])
                 )
-                if scheme == "compensated":
-                    sup_state, spec_vdtype, spec_carry = (
-                        _comp_resume_state(u_cur0, _ck_aux, sup_dtype)
-                    )
-                else:
-                    sup_state = (u_prev0, u_cur0)
-        if backend == "sharded":
-            if sup_mesh is None and fuse_steps > 1:
-                sup_mesh = (n_devices, 1, 1)
-            if sup_mesh is None:
-                from wavetpu.core.grid import choose_mesh_shape
-
-                shape = choose_mesh_shape(n_devices)
-            else:
-                shape = sup_mesh
-            n_procs = shape[0] * shape[1] * shape[2]
-        else:
-            sup_mesh = None
-            n_procs = 1
-        variant = "TPU"
-        spec = _sup.PathSpec(
-            backend=backend,
-            scheme=scheme,
-            fuse_steps=fuse_steps,
-            kernel=kernel,
-            dtype=sup_dtype,
-            v_dtype=spec_vdtype,
-            carry=spec_carry,
-            mesh_shape=sup_mesh,
-            c2tau2_field=c2_field,
-            compute_errors=compute_errors,
-            overlap=overlap,
-        )
-        opts = _sup.SupervisorOptions(
-            ckpt_every=ckpt_every,
-            ckpt_dir=ckpt_dir,
-            retries=sup_retries,
-            watchdog="no-watchdog" not in flags,
-            max_amp=sup_max_amp,
-        )
-        sup_out = _sup.supervise(
-            problem, spec, opts, state=sup_state, start_step=sup_start
-        )
-        result = sup_out.result
-        say(
-            f"supervisor: {sup_out.status}; "
-            f"{sup_out.checkpoints_written} checkpoint(s), "
-            f"{sup_out.retries_used} retr"
-            f"{'y' if sup_out.retries_used == 1 else 'ies'}, "
-            f"overhead {sup_out.overhead_seconds * 1000:.0f}ms"
-        )
-    elif backend == "sharded" and fuse_steps > 1 and scheme == "compensated":
-        # Distributed velocity-form flagship ((MX, 1, 1) meshes).
-        from wavetpu.solver import kfused_comp
-
-        if resume_is_sharded:
-            _v, _c = _ck_aux
-            inc = (
-                jnp.dtype(_v.dtype) == jnp.bfloat16
-                and jnp.dtype(resume_dtype) != jnp.bfloat16
-            )
-            if inc:
-                flags["v-dtype"] = "bf16"
-            result = kfused_comp.resume_kfused_comp_sharded(
-                problem,
-                _u_cur0,
-                _v,
-                None if inc else _c,
-                start_step=_start,
-                mesh_shape=_ck_mesh,
-                dtype=resume_dtype,
-                k=fuse_steps,
-                compute_errors=compute_errors,
-                v_dtype=jnp.bfloat16 if inc else None,
-                c2tau2_field=c2_field,
-            )
-            shape = _ck_mesh
-        else:
-            shape = mesh_shape or (n_devices, 1, 1)
-            v_bf16 = flags.get("v-dtype") == "bf16"
-            result = kfused_comp.solve_kfused_comp_sharded(
-                problem,
-                mesh_shape=shape,
-                dtype=dtype,
-                k=fuse_steps,
-                compute_errors=compute_errors,
-                stop_step=stop_step,
-                v_dtype=jnp.bfloat16 if v_bf16 else None,
-                carry=not v_bf16,
-                c2tau2_field=c2_field,
-            )
-        n_procs = shape[0] * shape[1] * shape[2]
-        variant = "TPU"
-    elif backend == "sharded" and fuse_steps > 1:
-        from wavetpu.solver import sharded_kfused
-
-        if resume_is_sharded:
-            result = sharded_kfused.resume_sharded_kfused(
-                problem,
-                _u_prev0,
-                _u_cur0,
-                start_step=_start,
-                mesh_shape=_ck_mesh,
-                dtype=resume_dtype,
-                k=fuse_steps,
-                compute_errors=compute_errors,
-                c2tau2_field=c2_field,
-            )
-            shape = _ck_mesh
-        else:
-            shape = mesh_shape or (n_devices, 1, 1)
-            result = sharded_kfused.solve_sharded_kfused(
-                problem,
-                mesh_shape=shape,
-                dtype=dtype,
-                k=fuse_steps,
-                compute_errors=compute_errors,
-                stop_step=stop_step,
-                c2tau2_field=c2_field,
-            )
-        n_procs = shape[0] * shape[1] * shape[2]
-        variant = "TPU"
-    elif backend == "sharded":
-        from wavetpu.solver import sharded
-
-        if resume_is_sharded:
-            _v, _c = _ck_aux if _ck_aux is not None else (None, None)
-            result = sharded.resume_sharded(
-                problem,
-                _u_prev0,
-                _u_cur0,
-                start_step=_start,
-                mesh_shape=_ck_mesh,
-                dtype=resume_dtype,
-                kernel=kernel,
-                overlap=overlap,
-                compute_errors=compute_errors,
-                scheme=scheme,
-                comp_v=_v,
-                comp_carry=_c,
-                c2tau2_field=c2_field,
-            )
-            shape = _ck_mesh
-        else:
-            result = sharded.solve_sharded(
-                problem,
-                mesh_shape=mesh_shape,
-                dtype=dtype,
-                compute_errors=compute_errors,
-                kernel=kernel,
-                overlap=overlap,
-                stop_step=stop_step,
-                scheme=scheme,
-                c2tau2_field=c2_field,
-            )
-            from wavetpu.core.grid import choose_mesh_shape
-
-            shape = mesh_shape or choose_mesh_shape(n_devices)
-        n_procs = shape[0] * shape[1] * shape[2]
-        variant = "TPU"
-    else:
-        from wavetpu.solver import leapfrog
-
-        step_fn = None
-        interpret = jax.default_backend() != "tpu"
-        if kernel == "pallas":
-            from wavetpu.kernels import stencil_pallas
-
-            step_fn = stencil_pallas.make_step_fn(
-                interpret=interpret, c2tau2_field=c2_field
-            )
-        elif c2_field is not None:
-            from wavetpu.kernels import stencil_ref as _sr
-
-            step_fn = _sr.make_variable_c_step(c2_field)
-        if resume_state is not None:
-            u_prev0, u_cur0, start = resume_state
-            # Unless --dtype was given explicitly, resume in the dtype the
-            # checkpoint was saved with - casting would break the
-            # bitwise-equal-resume guarantee (io/checkpoint.py).
+            except Exception as e:
+                # Missing/truncated shard files, step/meta mismatch from a
+                # mid-save preemption, or too few devices for the stored
+                # mesh - same clean exit as a corrupt .npz.
+                print(f"error: cannot load checkpoint: {e}", file=sys.stderr)
+                _abort_telemetry()
+                return 2
             resume_dtype = (
-                dtype if "dtype" in flags else jnp.dtype(u_cur0.dtype)
+                dtype if "dtype" in flags else jnp.dtype(_u_cur0.dtype)
             )
-            if scheme == "compensated" and fuse_steps > 1:
-                from wavetpu.solver import kfused_comp
 
+        sup_out = None
+        if supervised:
+            # Supervised solve (run/supervisor.py): every solver path below
+            # has a supervised twin - chunked march through cached chunk
+            # programs, rotating checkpoints, watchdog, signal handling.
+            from wavetpu.run import supervisor as _sup
+
+            ckpt_dir = flags.get("ckpt-dir") or rotation_root
+            if not ckpt_dir:
+                print(
+                    "error: --ckpt-every needs --ckpt-dir DIR (or --resume "
+                    "of an existing rotation root)",
+                    file=sys.stderr,
+                )
+                _abort_telemetry()
+                return 2
+            spec_vdtype = None
+            spec_carry = True
+            sup_state = None
+            sup_start = None
+            sup_mesh = mesh_shape
+            sup_dtype = dtype
+            if scheme == "compensated" and fuse_steps > 1 and \
+                    "resume" not in flags:
+                v_bf16 = flags.get("v-dtype") == "bf16"
+                spec_vdtype = jnp.bfloat16 if v_bf16 else None
+                spec_carry = not v_bf16
+            def _comp_resume_state(u_cur0, aux, st_dtype):
+                # Shared bf16-increment detection: a bf16 v stream beside a
+                # non-bf16 carrier marks the carry-less increment form
+                # (k-fused only); the sidecar must record the mode that ran.
+                _v, _c = aux
+                inc = (
+                    fuse_steps > 1
+                    and jnp.dtype(_v.dtype) == jnp.bfloat16
+                    and jnp.dtype(st_dtype) != jnp.bfloat16
+                )
+                if inc:
+                    flags["v-dtype"] = "bf16"
+                return (
+                    (u_cur0, _v, None if inc else _c),
+                    jnp.bfloat16 if inc else None,
+                    not inc,
+                )
+
+            if "resume" in flags:
+                if resume_is_sharded:
+                    sup_dtype = resume_dtype
+                    sup_mesh = _ck_mesh
+                    sup_start = _start
+                    if scheme == "compensated":
+                        sup_state, spec_vdtype, spec_carry = (
+                            _comp_resume_state(_u_cur0, _ck_aux, sup_dtype)
+                        )
+                    else:
+                        sup_state = (_u_prev0, _u_cur0)
+                else:
+                    u_prev0, u_cur0, sup_start = resume_state
+                    sup_dtype = (
+                        dtype if "dtype" in flags
+                        else jnp.dtype(u_cur0.dtype)
+                    )
+                    if scheme == "compensated":
+                        sup_state, spec_vdtype, spec_carry = (
+                            _comp_resume_state(u_cur0, _ck_aux, sup_dtype)
+                        )
+                    else:
+                        sup_state = (u_prev0, u_cur0)
+            if backend == "sharded":
+                if sup_mesh is None and fuse_steps > 1:
+                    sup_mesh = (n_devices, 1, 1)
+                if sup_mesh is None:
+                    from wavetpu.core.grid import choose_mesh_shape
+
+                    shape = choose_mesh_shape(n_devices)
+                else:
+                    shape = sup_mesh
+                n_procs = shape[0] * shape[1] * shape[2]
+            else:
+                sup_mesh = None
+                n_procs = 1
+            variant = "TPU"
+            spec = _sup.PathSpec(
+                backend=backend,
+                scheme=scheme,
+                fuse_steps=fuse_steps,
+                kernel=kernel,
+                dtype=sup_dtype,
+                v_dtype=spec_vdtype,
+                carry=spec_carry,
+                mesh_shape=sup_mesh,
+                c2tau2_field=c2_field,
+                compute_errors=compute_errors,
+                overlap=overlap,
+            )
+            opts = _sup.SupervisorOptions(
+                ckpt_every=ckpt_every,
+                ckpt_dir=ckpt_dir,
+                retries=sup_retries,
+                watchdog="no-watchdog" not in flags,
+                max_amp=sup_max_amp,
+            )
+            sup_out = _sup.supervise(
+                problem, spec, opts, state=sup_state, start_step=sup_start
+            )
+            result = sup_out.result
+            say(
+                f"supervisor: {sup_out.status}; "
+                f"{sup_out.checkpoints_written} checkpoint(s), "
+                f"{sup_out.retries_used} retr"
+                f"{'y' if sup_out.retries_used == 1 else 'ies'}, "
+                f"overhead {sup_out.overhead_seconds * 1000:.0f}ms"
+            )
+        elif backend == "sharded" and fuse_steps > 1 and \
+                scheme == "compensated":
+            # Distributed velocity-form flagship ((MX, 1, 1) meshes).
+            from wavetpu.solver import kfused_comp
+
+            if resume_is_sharded:
                 _v, _c = _ck_aux
-                # A bf16 increment stream marks the carry-less
-                # increment-form checkpoint; its stored carry (zeros) is
-                # dropped.
                 inc = (
                     jnp.dtype(_v.dtype) == jnp.bfloat16
                     and jnp.dtype(resume_dtype) != jnp.bfloat16
                 )
                 if inc:
-                    # The sidecar must record the mode that actually ran,
-                    # not the (absent) flag.
                     flags["v-dtype"] = "bf16"
-                result = kfused_comp.resume_kfused_comp(
+                result = kfused_comp.resume_kfused_comp_sharded(
                     problem,
-                    u_cur0,
+                    _u_cur0,
                     _v,
                     None if inc else _c,
-                    start_step=start,
+                    start_step=_start,
+                    mesh_shape=_ck_mesh,
                     dtype=resume_dtype,
                     k=fuse_steps,
                     compute_errors=compute_errors,
-                    interpret=interpret,
                     v_dtype=jnp.bfloat16 if inc else None,
+                    c2tau2_field=c2_field,
+                )
+                shape = _ck_mesh
+            else:
+                shape = mesh_shape or (n_devices, 1, 1)
+                v_bf16 = flags.get("v-dtype") == "bf16"
+                result = kfused_comp.solve_kfused_comp_sharded(
+                    problem,
+                    mesh_shape=shape,
+                    dtype=dtype,
+                    k=fuse_steps,
+                    compute_errors=compute_errors,
+                    stop_step=stop_step,
+                    v_dtype=jnp.bfloat16 if v_bf16 else None,
+                    carry=not v_bf16,
+                    c2tau2_field=c2_field,
+                )
+            n_procs = shape[0] * shape[1] * shape[2]
+            variant = "TPU"
+        elif backend == "sharded" and fuse_steps > 1:
+            from wavetpu.solver import sharded_kfused
+
+            if resume_is_sharded:
+                result = sharded_kfused.resume_sharded_kfused(
+                    problem,
+                    _u_prev0,
+                    _u_cur0,
+                    start_step=_start,
+                    mesh_shape=_ck_mesh,
+                    dtype=resume_dtype,
+                    k=fuse_steps,
+                    compute_errors=compute_errors,
+                    c2tau2_field=c2_field,
+                )
+                shape = _ck_mesh
+            else:
+                shape = mesh_shape or (n_devices, 1, 1)
+                result = sharded_kfused.solve_sharded_kfused(
+                    problem,
+                    mesh_shape=shape,
+                    dtype=dtype,
+                    k=fuse_steps,
+                    compute_errors=compute_errors,
+                    stop_step=stop_step,
+                    c2tau2_field=c2_field,
+                )
+            n_procs = shape[0] * shape[1] * shape[2]
+            variant = "TPU"
+        elif backend == "sharded":
+            from wavetpu.solver import sharded
+
+            if resume_is_sharded:
+                _v, _c = _ck_aux if _ck_aux is not None else (None, None)
+                result = sharded.resume_sharded(
+                    problem,
+                    _u_prev0,
+                    _u_cur0,
+                    start_step=_start,
+                    mesh_shape=_ck_mesh,
+                    dtype=resume_dtype,
+                    kernel=kernel,
+                    overlap=overlap,
+                    compute_errors=compute_errors,
+                    scheme=scheme,
+                    comp_v=_v,
+                    comp_carry=_c,
+                    c2tau2_field=c2_field,
+                )
+                shape = _ck_mesh
+            else:
+                result = sharded.solve_sharded(
+                    problem,
+                    mesh_shape=mesh_shape,
+                    dtype=dtype,
+                    compute_errors=compute_errors,
+                    kernel=kernel,
+                    overlap=overlap,
+                    stop_step=stop_step,
+                    scheme=scheme,
+                    c2tau2_field=c2_field,
+                )
+                from wavetpu.core.grid import choose_mesh_shape
+
+                shape = mesh_shape or choose_mesh_shape(n_devices)
+            n_procs = shape[0] * shape[1] * shape[2]
+            variant = "TPU"
+        else:
+            from wavetpu.solver import leapfrog
+
+            step_fn = None
+            interpret = jax.default_backend() != "tpu"
+            if kernel == "pallas":
+                from wavetpu.kernels import stencil_pallas
+
+                step_fn = stencil_pallas.make_step_fn(
+                    interpret=interpret, c2tau2_field=c2_field
+                )
+            elif c2_field is not None:
+                from wavetpu.kernels import stencil_ref as _sr
+
+                step_fn = _sr.make_variable_c_step(c2_field)
+            if resume_state is not None:
+                u_prev0, u_cur0, start = resume_state
+                # Unless --dtype was given explicitly, resume in the dtype the
+                # checkpoint was saved with - casting would break the
+                # bitwise-equal-resume guarantee (io/checkpoint.py).
+                resume_dtype = (
+                    dtype if "dtype" in flags else jnp.dtype(u_cur0.dtype)
+                )
+                if scheme == "compensated" and fuse_steps > 1:
+                    from wavetpu.solver import kfused_comp
+
+                    _v, _c = _ck_aux
+                    # A bf16 increment stream marks the carry-less
+                    # increment-form checkpoint; its stored carry (zeros) is
+                    # dropped.
+                    inc = (
+                        jnp.dtype(_v.dtype) == jnp.bfloat16
+                        and jnp.dtype(resume_dtype) != jnp.bfloat16
+                    )
+                    if inc:
+                        # The sidecar must record the mode that actually ran,
+                        # not the (absent) flag.
+                        flags["v-dtype"] = "bf16"
+                    result = kfused_comp.resume_kfused_comp(
+                        problem,
+                        u_cur0,
+                        _v,
+                        None if inc else _c,
+                        start_step=start,
+                        dtype=resume_dtype,
+                        k=fuse_steps,
+                        compute_errors=compute_errors,
+                        interpret=interpret,
+                        v_dtype=jnp.bfloat16 if inc else None,
+                        c2tau2_field=c2_field,
+                    )
+                elif scheme == "compensated":
+                    comp_step_fn = None
+                    if kernel == "pallas":
+                        from wavetpu.kernels import stencil_pallas as _sp
+
+                        comp_step_fn = _sp.make_compensated_step_fn(
+                            interpret=interpret
+                        )
+                    _v, _c = _ck_aux
+                    result = leapfrog.resume_compensated(
+                        problem,
+                        u_cur0,
+                        _v,
+                        _c,
+                        start_step=start,
+                        dtype=resume_dtype,
+                        comp_step_fn=comp_step_fn,
+                        compute_errors=compute_errors,
+                    )
+                elif fuse_steps > 1 and problem.N % fuse_steps:
+                    # Uneven single-device k-fusion runs the pad-and-mask
+                    # path on a (1,1,1) grid (bitwise equal to the 1-step
+                    # pallas march on real planes).
+                    from wavetpu.solver import sharded_kfused
+
+                    result = sharded_kfused.resume_sharded_kfused(
+                        problem,
+                        u_prev0,
+                        u_cur0,
+                        start_step=start,
+                        n_shards=1,
+                        dtype=resume_dtype,
+                        k=fuse_steps,
+                        compute_errors=compute_errors,
+                        interpret=interpret,
+                        c2tau2_field=c2_field,
+                    )
+                elif fuse_steps > 1:
+                    from wavetpu.solver import kfused
+
+                    result = kfused.resume_kfused(
+                        problem,
+                        u_prev0,
+                        u_cur0,
+                        start_step=start,
+                        dtype=resume_dtype,
+                        k=fuse_steps,
+                        compute_errors=compute_errors,
+                        interpret=interpret,
+                        c2tau2_field=c2_field,
+                    )
+                else:
+                    result = leapfrog.resume(
+                        problem,
+                        u_prev0,
+                        u_cur0,
+                        start_step=start,
+                        dtype=resume_dtype,
+                        step_fn=step_fn,
+                        compute_errors=compute_errors,
+                    )
+            elif scheme == "compensated" and fuse_steps > 1:
+                from wavetpu.solver import kfused_comp
+
+                v_bf16 = flags.get("v-dtype") == "bf16"
+                result = kfused_comp.solve_kfused_comp(
+                    problem,
+                    dtype=dtype,
+                    k=fuse_steps,
+                    compute_errors=compute_errors,
+                    stop_step=stop_step,
+                    interpret=interpret,
+                    v_dtype=jnp.bfloat16 if v_bf16 else None,
+                    carry=not v_bf16,
                     c2tau2_field=c2_field,
                 )
             elif scheme == "compensated":
                 comp_step_fn = None
                 if kernel == "pallas":
-                    from wavetpu.kernels import stencil_pallas as _sp
-
-                    comp_step_fn = _sp.make_compensated_step_fn(
+                    comp_step_fn = stencil_pallas.make_compensated_step_fn(
                         interpret=interpret
                     )
-                _v, _c = _ck_aux
-                result = leapfrog.resume_compensated(
+                result = leapfrog.solve_compensated(
                     problem,
-                    u_cur0,
-                    _v,
-                    _c,
-                    start_step=start,
-                    dtype=resume_dtype,
+                    dtype=dtype,
                     comp_step_fn=comp_step_fn,
                     compute_errors=compute_errors,
+                    stop_step=stop_step,
                 )
             elif fuse_steps > 1 and problem.N % fuse_steps:
-                # Uneven single-device k-fusion runs the pad-and-mask
-                # path on a (1,1,1) grid (bitwise equal to the 1-step
-                # pallas march on real planes).
                 from wavetpu.solver import sharded_kfused
 
-                result = sharded_kfused.resume_sharded_kfused(
+                result = sharded_kfused.solve_sharded_kfused(
                     problem,
-                    u_prev0,
-                    u_cur0,
-                    start_step=start,
                     n_shards=1,
-                    dtype=resume_dtype,
+                    dtype=dtype,
                     k=fuse_steps,
                     compute_errors=compute_errors,
+                    stop_step=stop_step,
                     interpret=interpret,
                     c2tau2_field=c2_field,
                 )
             elif fuse_steps > 1:
                 from wavetpu.solver import kfused
 
-                result = kfused.resume_kfused(
+                result = kfused.solve_kfused(
                     problem,
-                    u_prev0,
-                    u_cur0,
-                    start_step=start,
-                    dtype=resume_dtype,
+                    dtype=dtype,
                     k=fuse_steps,
                     compute_errors=compute_errors,
+                    stop_step=stop_step,
                     interpret=interpret,
                     c2tau2_field=c2_field,
                 )
             else:
-                result = leapfrog.resume(
+                result = leapfrog.solve(
                     problem,
-                    u_prev0,
-                    u_cur0,
-                    start_step=start,
-                    dtype=resume_dtype,
+                    dtype=dtype,
                     step_fn=step_fn,
                     compute_errors=compute_errors,
+                    stop_step=stop_step,
                 )
-        elif scheme == "compensated" and fuse_steps > 1:
-            from wavetpu.solver import kfused_comp
+            n_procs = 1
+            variant = "TPU"
 
-            v_bf16 = flags.get("v-dtype") == "bf16"
-            result = kfused_comp.solve_kfused_comp(
-                problem,
-                dtype=dtype,
-                k=fuse_steps,
-                compute_errors=compute_errors,
-                stop_step=stop_step,
-                interpret=interpret,
-                v_dtype=jnp.bfloat16 if v_bf16 else None,
-                carry=not v_bf16,
-                c2tau2_field=c2_field,
-            )
-        elif scheme == "compensated":
-            comp_step_fn = None
-            if kernel == "pallas":
-                comp_step_fn = stencil_pallas.make_compensated_step_fn(
-                    interpret=interpret
-                )
-            result = leapfrog.solve_compensated(
-                problem,
-                dtype=dtype,
-                comp_step_fn=comp_step_fn,
-                compute_errors=compute_errors,
-                stop_step=stop_step,
-            )
-        elif fuse_steps > 1 and problem.N % fuse_steps:
-            from wavetpu.solver import sharded_kfused
-
-            result = sharded_kfused.solve_sharded_kfused(
-                problem,
-                n_shards=1,
-                dtype=dtype,
-                k=fuse_steps,
-                compute_errors=compute_errors,
-                stop_step=stop_step,
-                interpret=interpret,
-                c2tau2_field=c2_field,
-            )
-        elif fuse_steps > 1:
-            from wavetpu.solver import kfused
-
-            result = kfused.solve_kfused(
-                problem,
-                dtype=dtype,
-                k=fuse_steps,
-                compute_errors=compute_errors,
-                stop_step=stop_step,
-                interpret=interpret,
-                c2tau2_field=c2_field,
-            )
-        else:
-            result = leapfrog.solve(
-                problem,
-                dtype=dtype,
-                step_fn=step_fn,
-                compute_errors=compute_errors,
-                stop_step=stop_step,
-            )
-        n_procs = 1
-        variant = "TPU"
-
-    if "save-state" in flags:
-        from wavetpu.io import checkpoint as _ckpt
-
-        if backend == "sharded":
-            # Multi-process aware internally: each process writes only its
-            # addressable shards, meta is gated on process 0.
-            ck_path = _ckpt.save_sharded_checkpoint(
-                flags["save-state"], result
-            )
-            say(f"checkpoint: {ck_path}")
-        elif is_main:
-            # Single-device state is fully replicated; one writer suffices
-            # (concurrent np.savez to one path is not atomic).
-            ck_path = _ckpt.save_checkpoint(flags["save-state"], result)
-            say(f"checkpoint: {ck_path}")
-
-    if profile_dir and is_main:
-        jax.profiler.stop_trace()
-        say(f"profile trace: {profile_dir}")
-
-    exchange_seconds = loop_seconds = None
-    probe_steps = None
-    if "phase-timing" in flags:
-        from wavetpu.solver import timing
-
-        # `shape` is the mesh the solve actually ran on (incl. a resumed
-        # checkpoint's mesh); the probe must time the same program.
-        pb = timing.measure_phase_breakdown(
-            problem,
-            mesh_shape=shape if backend == "sharded" else (1, 1, 1),
-            dtype=dtype,
-            kernel=kernel,
-            overlap=overlap,
-            fuse_steps=fuse_steps,
-            scheme=scheme,
-            v_dtype=(
-                jnp.bfloat16 if flags.get("v-dtype") == "bf16" else None
-            ),
+        _tracing.end_span(
+            solve_span, final_step=result.final_step,
+            gcells_per_s=round(result.gcells_per_second, 3),
         )
-        exchange_seconds, loop_seconds = pb.exchange_seconds, pb.loop_seconds
-        probe_steps = pb.steps_measured
 
-    if is_main:
-        from wavetpu.io import report
+        if "save-state" in flags:
+            from wavetpu.io import checkpoint as _ckpt
 
-        path = report.write_report(
-            result,
-            out_dir=out_dir,
-            n_procs=n_procs,
-            variant=variant,
-            errors_computed=compute_errors,
-            exchange_seconds=exchange_seconds,
-            loop_seconds=loop_seconds,
-            probe_steps=probe_steps,
-            run_config={
-                "backend": backend,
-                "kernel": kernel,
-                "scheme": scheme,
-                "fuse_steps": fuse_steps,
-                "mesh": list(shape) if backend == "sharded" else None,
-                # The state's actual dtype (a resumed run inherits the
-                # checkpoint's, which may differ from the flag default).
-                "dtype": jnp.dtype(result.u_cur.dtype).name,
-                "v_dtype": flags.get("v-dtype"),
-                "c2_field": flags.get("c2-field"),
-                "distributed": distributed,
-                "resumed": "resume" in flags,
-                "supervised": supervised,
-                "ckpt_every": ckpt_every if supervised else None,
-                "supervisor_status": (
-                    sup_out.status if sup_out is not None else None
+            if backend == "sharded":
+                # Multi-process aware internally: each process writes only its
+                # addressable shards, meta is gated on process 0.
+                ck_path = _ckpt.save_sharded_checkpoint(
+                    flags["save-state"], result
+                )
+                say(f"checkpoint: {ck_path}")
+            elif is_main:
+                # Single-device state is fully replicated; one writer suffices
+                # (concurrent np.savez to one path is not atomic).
+                ck_path = _ckpt.save_checkpoint(flags["save-state"], result)
+                say(f"checkpoint: {ck_path}")
+
+        if profile_dir and is_main:
+            jax.profiler.stop_trace()
+            say(f"profile trace: {profile_dir}")
+
+        exchange_seconds = loop_seconds = None
+        probe_steps = None
+        if "phase-timing" in flags:
+            from wavetpu.solver import timing
+
+            # `shape` is the mesh the solve actually ran on (incl. a resumed
+            # checkpoint's mesh); the probe must time the same program.
+            pb = timing.measure_phase_breakdown(
+                problem,
+                mesh_shape=shape if backend == "sharded" else (1, 1, 1),
+                dtype=dtype,
+                kernel=kernel,
+                overlap=overlap,
+                fuse_steps=fuse_steps,
+                scheme=scheme,
+                v_dtype=(
+                    jnp.bfloat16 if flags.get("v-dtype") == "bf16" else None
                 ),
-            },
-        )
-    say(f"grids initialized in {int(result.init_seconds * 1000)}ms")
-    say(
-        f"numerical solution calculated in "
-        f"{int(result.solve_seconds * 1000)}ms"
-    )
-    if exchange_seconds is not None:
-        say(f"total ICI exchange time: {int(exchange_seconds * 1000)}ms")
-        say(f"total loop time: {int(loop_seconds * 1000)}ms")
-    if compute_errors:
-        say(f"max abs error: {result.abs_errors.max():.6g}")
-    say(f"throughput: {result.gcells_per_second:.3f} Gcell-updates/s")
-    if is_main:
-        say(f"report: {path}")
-    if sup_out is not None and sup_out.status != "complete":
-        # Orchestration contract: distinct exit codes (3 = requeue with
-        # --resume, 4 = page an operator) and the resumable path in the
-        # output (docs/robustness.md).
-        if sup_out.status == "preempted":
-            say(f"preempted: checkpointed at step {sup_out.final_step}")
-        else:
-            say(
-                f"watchdog: numerical-health trip "
-                f"(guarded amax {sup_out.amax_last:g}); "
-                f"last good step {sup_out.final_step}"
             )
-        if sup_out.checkpoint_path:
-            say(f"resumable checkpoint: {sup_out.checkpoint_path}")
-        return sup_out.exit_code
-    return 0
+            exchange_seconds = pb.exchange_seconds
+            loop_seconds = pb.loop_seconds
+            probe_steps = pb.steps_measured
+
+        if is_main:
+            from wavetpu.io import report
+
+            path = report.write_report(
+                result,
+                out_dir=out_dir,
+                n_procs=n_procs,
+                variant=variant,
+                errors_computed=compute_errors,
+                exchange_seconds=exchange_seconds,
+                loop_seconds=loop_seconds,
+                probe_steps=probe_steps,
+                run_config={
+                    "backend": backend,
+                    "kernel": kernel,
+                    "scheme": scheme,
+                    "fuse_steps": fuse_steps,
+                    "mesh": list(shape) if backend == "sharded" else None,
+                    # The state's actual dtype (a resumed run inherits the
+                    # checkpoint's, which may differ from the flag default).
+                    "dtype": jnp.dtype(result.u_cur.dtype).name,
+                    "v_dtype": flags.get("v-dtype"),
+                    "c2_field": flags.get("c2-field"),
+                    "distributed": distributed,
+                    "resumed": "resume" in flags,
+                    "supervised": supervised,
+                    "ckpt_every": ckpt_every if supervised else None,
+                    "supervisor_status": (
+                        sup_out.status if sup_out is not None else None
+                    ),
+                },
+            )
+        say(f"grids initialized in {int(result.init_seconds * 1000)}ms")
+        say(
+            f"numerical solution calculated in "
+            f"{int(result.solve_seconds * 1000)}ms"
+        )
+        if exchange_seconds is not None:
+            say(f"total ICI exchange time: {int(exchange_seconds * 1000)}ms")
+            say(f"total loop time: {int(loop_seconds * 1000)}ms")
+        if compute_errors:
+            say(f"max abs error: {result.abs_errors.max():.6g}")
+        say(f"throughput: {result.gcells_per_second:.3f} Gcell-updates/s")
+        if is_main:
+            say(f"report: {path}")
+        if sup_out is not None and sup_out.status != "complete":
+            # Orchestration contract: distinct exit codes (3 = requeue with
+            # --resume, 4 = page an operator) and the resumable path in the
+            # output (docs/robustness.md).
+            if sup_out.status == "preempted":
+                say(f"preempted: checkpointed at step {sup_out.final_step}")
+            else:
+                say(
+                    f"watchdog: numerical-health trip "
+                    f"(guarded amax {sup_out.amax_last:g}); "
+                    f"last good step {sup_out.final_step}"
+                )
+            if sup_out.checkpoint_path:
+                say(f"resumable checkpoint: {sup_out.checkpoint_path}")
+            if telemetry is not None:
+                telemetry.stop()
+            return sup_out.exit_code
+        if telemetry is not None:
+            telemetry.stop()
+        return 0
+    except BaseException:
+        # A crash mid-dispatch (XLA error, bad mesh, report I/O)
+        # must still emit the open cli.solve span and the final
+        # heartbeat, and must not leave the process tracer bound to
+        # this run's trace file: in-process callers (tests, library
+        # use of cli.main) never reach the atexit net, and their
+        # next cli.main call must not inherit a stale tracer.
+        # (Span end and telemetry.stop() are both idempotent, so a
+        # raise after the success-path end_span is safe too.)
+        _abort_telemetry()
+        raise
 
 
 if __name__ == "__main__":

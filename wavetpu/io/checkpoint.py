@@ -62,12 +62,60 @@ def _decode_field(arr: np.ndarray, tag: Optional[str]) -> np.ndarray:
     return arr
 
 
+def _record_io(op: str, kind: str, nbytes: float, seconds: float) -> None:
+    """Checkpoint I/O telemetry (bytes / seconds / op counters into the
+    process registry, plus a span when tracing is on - emitted by the
+    caller).  Never lets an obs failure break a checkpoint."""
+    try:
+        from wavetpu.obs import metrics as _obs
+
+        _obs.record_checkpoint_io(op, kind, nbytes, seconds)
+    except Exception:
+        pass
+
+
+def _tree_bytes(path_dir: str) -> int:
+    """Directory byte total for telemetry - best-effort: a file another
+    process renames/removes mid-walk (concurrent multi-host writers
+    cleaning tmp debris) must not fail a checkpoint op that already
+    succeeded."""
+    import os
+
+    total = 0
+    try:
+        entries = os.listdir(path_dir)
+    except OSError:
+        return 0
+    for e in entries:
+        try:
+            p = os.path.join(path_dir, e)
+            if os.path.isfile(p):
+                total += os.path.getsize(p)
+        except OSError:
+            pass
+    return total
+
+
+def _file_bytes(path: str) -> int:
+    import os
+
+    try:
+        return os.path.getsize(path) if os.path.exists(path) else 0
+    except OSError:
+        return 0
+
+
 def save_checkpoint(path: str, result: SolveResult) -> str:
     """Write (u_prev, u_cur, step, problem) from a (possibly partial) solve.
 
     `result.final_step` (set by solve/resume) is the layer index `u_cur`
     holds; a full-run result checkpoints its final state.
     """
+    import time as _time
+
+    from wavetpu.obs import tracing
+
+    t0 = _time.perf_counter()
     p = result.problem
     step = (
         result.final_step if result.final_step is not None else p.timesteps
@@ -110,7 +158,13 @@ def save_checkpoint(path: str, result: SolveResult) -> str:
             for k, v in dataclasses.asdict(p).items()
         },
     )
-    return path if path.endswith(".npz") else path + ".npz"
+    out = path if path.endswith(".npz") else path + ".npz"
+    seconds = _time.perf_counter() - t0
+    nbytes = _file_bytes(out)
+    _record_io("save", "single", nbytes, seconds)
+    tracing.event("checkpoint.save", kind="single", step=step,
+                  bytes=nbytes, seconds=round(seconds, 6), path=out)
+    return out
 
 
 def _problem_from_npz(z) -> Problem:
@@ -127,6 +181,9 @@ def _problem_from_npz(z) -> Problem:
 
 def load_checkpoint(path: str) -> Tuple[Problem, np.ndarray, np.ndarray, int]:
     """Read a checkpoint back as (problem, u_prev, u_cur, step)."""
+    import time as _time
+
+    t0 = _time.perf_counter()
     with np.load(path) as z:
         version = int(z["format_version"])
         if version != _FORMAT_VERSION:
@@ -140,7 +197,10 @@ def load_checkpoint(path: str) -> Tuple[Problem, np.ndarray, np.ndarray, int]:
 
         u_prev = _decode_field(z["u_prev"], tag("u_prev_dtype"))
         u_cur = _decode_field(z["u_cur"], tag("u_cur_dtype"))
-        return problem, u_prev, u_cur, int(z["step"])
+        step = int(z["step"])
+    _record_io("load", "single", _file_bytes(path),
+               _time.perf_counter() - t0)
+    return problem, u_prev, u_cur, step
 
 
 def _shard_filename(starts) -> str:
@@ -200,11 +260,14 @@ def save_sharded_checkpoint(path_dir: str, result: SolveResult) -> str:
     exists.  Legacy .npz shard checkpoints remain loadable.
     """
     import os
+    import time as _time
 
     import jax
 
     from wavetpu.io import nativeio
+    from wavetpu.obs import tracing
 
+    t0 = _time.perf_counter()
     p = result.problem
     step = (
         result.final_step if result.final_step is not None else p.timesteps
@@ -302,6 +365,13 @@ def save_sharded_checkpoint(path_dir: str, result: SolveResult) -> str:
                 for k, v in dataclasses.asdict(p).items()
             },
         )
+    seconds = _time.perf_counter() - t0
+    # Directory total (this process's shards + meta; a reused directory
+    # also counts prior files - rotation entries are always fresh).
+    nbytes = _tree_bytes(path_dir)
+    _record_io("save", "sharded", nbytes, seconds)
+    tracing.event("checkpoint.save", kind="sharded", step=step,
+                  bytes=nbytes, seconds=round(seconds, 6), path=path_dir)
     return path_dir
 
 
@@ -339,6 +409,7 @@ def load_sharded_checkpoint(path_dir: str, devices=None):
     multi-host-scalable as the save path.
     """
     import os
+    import time as _time
 
     import jax
     from jax.sharding import NamedSharding
@@ -346,6 +417,7 @@ def load_sharded_checkpoint(path_dir: str, devices=None):
 
     from wavetpu.core.grid import AXIS_NAMES, Topology, build_mesh
 
+    t0 = _time.perf_counter()
     problem, step, mesh_shape, _, scheme = load_sharded_meta(path_dir)
     topo = Topology(N=problem.N, mesh_shape=mesh_shape)
     if devices is None:
@@ -425,6 +497,8 @@ def load_sharded_checkpoint(path_dir: str, devices=None):
     aux = None
     if compensated:
         aux = (assemble(buffers["comp_v"]), assemble(buffers["comp_carry"]))
+    _record_io("load", "sharded", _tree_bytes(path_dir),
+               _time.perf_counter() - t0)
     return problem, u_prev, u_cur, step, mesh_shape, scheme, aux
 
 

@@ -1,0 +1,17 @@
+"""Unified telemetry: metrics registry, span tracing, heartbeat files.
+
+ * `obs.registry`  - process-wide counters/gauges/histograms, JSON
+   snapshot + Prometheus text exposition (one consistency lock).
+ * `obs.tracing`   - JSONL span/event emission with a context-manager
+   API that also opens matching `jax.profiler.TraceAnnotation`s.
+ * `obs.metrics`   - the domain instruments (per-solve throughput,
+   checkpoint I/O, supervisor counters).
+ * `obs.telemetry` - `--telemetry-dir` glue: trace file + periodic
+   registry snapshots (heartbeat.jsonl / metrics.prom).
+ * `obs.report`    - `wavetpu trace-report`: per-kind span stats and
+   per-request critical-path views over a trace file.
+
+Metric catalog and span kinds: docs/observability.md.
+"""
+
+from wavetpu.obs.registry import MetricsRegistry, get_registry  # noqa: F401

@@ -1,0 +1,89 @@
+"""Domain instruments over the process-wide registry.
+
+Thin helpers the solver / checkpoint / supervisor layers call at their
+natural host boundaries (end of a solve, end of a checkpoint write) so
+each call site stays one line.  Everything lands in
+`registry.get_registry()` - the process-wide registry the telemetry
+heartbeat snapshots and `wavetpu trace-report` complements.
+
+Metric catalog (docs/observability.md is the user-facing copy):
+
+  wavetpu_solves_total{path}            completed solve entry points
+  wavetpu_solve_layers_total{path}      leapfrog layers marched
+  wavetpu_solve_cells_total{path}       cell updates ((N+1)^3 x layers)
+  wavetpu_solve_seconds_total{path}     solve wall seconds (excl compile)
+  wavetpu_last_solve_gcells_per_s{path} gauge: most recent throughput
+  wavetpu_checkpoint_ops_total{op,kind}      save/load x single/sharded
+  wavetpu_checkpoint_bytes_total{op,kind}    file bytes moved
+  wavetpu_checkpoint_seconds_total{op,kind}  wall seconds
+  wavetpu_supervisor_chunks_total       chunk programs executed
+  wavetpu_supervisor_checkpoints_total  rotation entries written
+  wavetpu_supervisor_retries_total      watchdog auto-retries taken
+  wavetpu_supervisor_watchdog_trips_total   health-check failures
+  wavetpu_supervisor_step               gauge: last completed layer
+"""
+
+from __future__ import annotations
+
+from wavetpu.obs.registry import get_registry
+
+
+def record_solve(result, path: str) -> None:
+    """Per-solve throughput counters, called at solver entry points.
+    `result` is a leapfrog.SolveResult; `path` names the solver family
+    (roll / pallas / kfused / kfused_comp / sharded / sharded_kfused)."""
+    reg = get_registry()
+    problem = result.problem
+    steps = (
+        result.steps_computed
+        if result.steps_computed else problem.timesteps
+    )
+    cells = float(problem.cells_per_step) * steps
+    reg.counter(
+        "wavetpu_solves_total", "completed solve entry points", ("path",)
+    ).inc(path=path)
+    reg.counter(
+        "wavetpu_solve_layers_total", "leapfrog layers marched", ("path",)
+    ).inc(steps, path=path)
+    reg.counter(
+        "wavetpu_solve_cells_total",
+        "cell updates marched ((N+1)^3 per layer)", ("path",)
+    ).inc(cells, path=path)
+    reg.counter(
+        "wavetpu_solve_seconds_total",
+        "solve wall seconds (excludes compile)", ("path",)
+    ).inc(float(result.solve_seconds or 0.0), path=path)
+    reg.gauge(
+        "wavetpu_last_solve_gcells_per_s",
+        "throughput of the most recent solve", ("path",)
+    ).set(float(result.gcells_per_second or 0.0), path=path)
+
+
+def record_checkpoint_io(op: str, kind: str, nbytes: float,
+                         seconds: float) -> None:
+    """Checkpoint I/O accounting: `op` save|load, `kind` single|sharded."""
+    reg = get_registry()
+    labels = dict(op=op, kind=kind)
+    reg.counter(
+        "wavetpu_checkpoint_ops_total", "checkpoint operations",
+        ("op", "kind")
+    ).inc(**labels)
+    reg.counter(
+        "wavetpu_checkpoint_bytes_total", "checkpoint file bytes moved",
+        ("op", "kind")
+    ).inc(float(nbytes), **labels)
+    reg.counter(
+        "wavetpu_checkpoint_seconds_total", "checkpoint I/O wall seconds",
+        ("op", "kind")
+    ).inc(float(seconds), **labels)
+
+
+def supervisor_counter(name: str, help: str):
+    return get_registry().counter(f"wavetpu_supervisor_{name}", help)
+
+
+def supervisor_step_gauge():
+    return get_registry().gauge(
+        "wavetpu_supervisor_step", "last completed layer of the "
+        "supervised march"
+    )

@@ -1,0 +1,210 @@
+"""`wavetpu trace-report`: summarize a JSONL span trace.
+
+Reads the trace file `--telemetry-dir` produces (obs/tracing.py records)
+and answers the two operator questions a raw JSONL tail cannot:
+
+ * WHERE did time go, by span kind - count / total / p50 / p95 per kind,
+   sorted by total time, plus event counts;
+ * WHERE did ONE request's latency go - `--request ID` prints the
+   request's span tree (queue wait vs batch execute vs compile), joining
+   the HTTP-thread request span to the scheduler-thread batch span on
+   the shared `request_id`/`request_ids` attributes.
+
+Pure stdlib + host-side; never imports jax (a babysitting operator runs
+this against a live run's telemetry dir without touching the backend).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List, Optional, Sequence
+
+_USAGE = (
+    "usage: wavetpu trace-report TRACE.jsonl [--kind KIND] "
+    "[--request REQUEST_ID]"
+)
+
+
+def load_trace(path: str) -> List[dict]:
+    """Parse a JSONL trace; malformed lines are counted, not fatal (the
+    file may be mid-write when an operator runs the report)."""
+    records, bad = [], 0
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                bad += 1
+                continue
+            if isinstance(rec, dict) and "kind" in rec:
+                records.append(rec)
+    if bad:
+        print(f"note: skipped {bad} malformed line(s)", file=sys.stderr)
+    return records
+
+
+def percentile_nearest_rank(sorted_vals: Sequence[float],
+                            p: float) -> float:
+    """Nearest-rank percentile over an already-sorted sequence - the ONE
+    percentile definition shared by trace-report and the serve layer's
+    /metrics latency fields (scheduler.ServeMetrics), so the two views
+    can never disagree on identical data."""
+    idx = min(len(sorted_vals) - 1, int(round(p * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def summarize(records: Sequence[dict]) -> dict:
+    """Per-kind span stats + event counts, machine-readable."""
+    spans: Dict[str, List[float]] = {}
+    events: Dict[str, int] = {}
+    for r in records:
+        if r.get("type") == "span":
+            spans.setdefault(r["kind"], []).append(float(r.get("dur_s", 0.0)))
+        else:
+            events[r["kind"]] = events.get(r["kind"], 0) + 1
+    kinds = {}
+    for kind, durs in spans.items():
+        durs.sort()
+        kinds[kind] = {
+            "count": len(durs),
+            "total_s": round(sum(durs), 6),
+            "p50_ms": round(percentile_nearest_rank(durs, 0.50) * 1e3, 3),
+            "p95_ms": round(percentile_nearest_rank(durs, 0.95) * 1e3, 3),
+            "max_ms": round(durs[-1] * 1e3, 3),
+        }
+    return {"spans": kinds, "events": events,
+            "n_records": len(records)}
+
+
+def format_summary(summary: dict) -> str:
+    lines = []
+    header = (
+        f"{'span kind':<34} {'count':>6} {'total_s':>9} "
+        f"{'p50_ms':>9} {'p95_ms':>9} {'max_ms':>9}"
+    )
+    lines.append(header)
+    lines.append("-" * len(header))
+    by_total = sorted(
+        summary["spans"].items(), key=lambda kv: -kv[1]["total_s"]
+    )
+    for kind, st in by_total:
+        lines.append(
+            f"{kind:<34} {st['count']:>6} {st['total_s']:>9.3f} "
+            f"{st['p50_ms']:>9.2f} {st['p95_ms']:>9.2f} "
+            f"{st['max_ms']:>9.2f}"
+        )
+    if summary["events"]:
+        lines.append("")
+        lines.append(f"{'event kind':<34} {'count':>6}")
+        for kind, n in sorted(summary["events"].items()):
+            lines.append(f"{kind:<34} {n:>6}")
+    lines.append("")
+    lines.append(f"{summary['n_records']} records")
+    return "\n".join(lines)
+
+
+def _touches_request(rec: dict, request_id: str) -> bool:
+    attrs = rec.get("attrs") or {}
+    if attrs.get("request_id") == request_id:
+        return True
+    ids = attrs.get("request_ids")
+    return isinstance(ids, (list, tuple)) and request_id in ids
+
+
+def request_view(records: Sequence[dict], request_id: str) -> List[dict]:
+    """Every span/event that belongs to one request's critical path:
+    records tagged with the request id (HTTP request span, the batch
+    that carried it) plus their tree descendants (execute / compile /
+    watchdog sub-spans), in start-time order."""
+    roots = [r for r in records if _touches_request(r, request_id)]
+    keep = {r["span_id"] for r in roots}
+    # Pull in descendants of any kept span (child spans carry no
+    # request tag of their own): one parent->children index + BFS, so a
+    # long-lived server's hundred-thousand-record trace stays O(n).
+    children: Dict[str, List[str]] = {}
+    for r in records:
+        parent = r.get("parent_id")
+        if parent is not None:
+            children.setdefault(parent, []).append(r["span_id"])
+    frontier = list(keep)
+    while frontier:
+        sid = frontier.pop()
+        for child in children.get(sid, ()):
+            if child not in keep:
+                keep.add(child)
+                frontier.append(child)
+    out = [r for r in records if r["span_id"] in keep]
+    out.sort(key=lambda r: r.get("t_start", 0.0))
+    return out
+
+
+def format_request_view(records: Sequence[dict], request_id: str) -> str:
+    if not records:
+        return f"no records for request {request_id}"
+    t0 = records[0].get("t_start", 0.0)
+    depth = {None: -1}
+    lines = [f"critical path of request {request_id}:"]
+    for r in records:
+        d = depth.get(r.get("parent_id"), 0) + 1
+        depth[r["span_id"]] = d
+        rel = (r.get("t_start", t0) - t0) * 1e3
+        dur = r.get("dur_s")
+        dur_txt = (
+            f"{dur * 1e3:9.2f}ms" if dur is not None else "    event"
+        )
+        attrs = r.get("attrs") or {}
+        attr_txt = " ".join(
+            f"{k}={v}" for k, v in sorted(attrs.items())
+            if k not in ("request_ids",) and not isinstance(v, (list, dict))
+        )
+        lines.append(
+            f"  +{rel:9.2f}ms {dur_txt}  {'  ' * d}{r['kind']}"
+            + (f"  [{attr_txt}]" if attr_txt else "")
+        )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    path = None
+    kind = None
+    request = None
+    it = iter(argv)
+    try:
+        for a in it:
+            if a == "--kind":
+                kind = next(it)
+            elif a == "--request":
+                request = next(it)
+            elif a.startswith("--"):
+                raise ValueError(f"unknown flag {a}")
+            elif path is None:
+                path = a
+            else:
+                raise ValueError(f"unexpected positional {a!r}")
+        if path is None:
+            raise ValueError("missing TRACE.jsonl path")
+    except (ValueError, StopIteration) as e:
+        print(f"error: {e}", file=sys.stderr)
+        print(_USAGE, file=sys.stderr)
+        return 2
+    try:
+        records = load_trace(path)
+    except OSError as e:
+        print(f"error: cannot read trace: {e}", file=sys.stderr)
+        return 2
+    if kind is not None:
+        records = [r for r in records if r["kind"] == kind]
+    if request is not None:
+        print(format_request_view(request_view(records, request), request))
+        return 0
+    print(format_summary(summarize(records)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

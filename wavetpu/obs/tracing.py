@@ -1,0 +1,242 @@
+"""Structured span tracing: JSONL application spans + XLA trace bridging.
+
+`--profile DIR` captures op-level XLA device traces but says nothing
+about the APPLICATION structure around them - which request a compile
+belonged to, how long a chunk waited on a checkpoint write.  This module
+emits that structure as newline-delimited JSON records an operator can
+tail and `wavetpu trace-report` can summarize:
+
+    {"type": "span", "kind": "supervisor.chunk", "span_id": "1f03-4",
+     "parent_id": "1f03-1", "thread": "MainThread",
+     "t_start": 1722772800.123, "dur_s": 0.512, "attrs": {...}}
+
+ * `span(kind, **attrs)` - context manager: allocates a span id, links
+   the enclosing span on the SAME THREAD as parent, measures wall time,
+   and writes one record on exit.  The yielded dict is the record's
+   `attrs`: mutate it to attach results discovered mid-span (occupancy,
+   cache verdicts).  While a span is open it also holds a matching
+   `jax.profiler.TraceAnnotation(kind)` - IF jax is already imported -
+   so application spans line up with device traces captured via
+   `--profile` in the same run.  (jax is never imported here: tracing
+   must not drag the backend in; `sys.modules` is consulted instead.)
+ * `begin_span()` / `end_span()` - the same span without the `with`
+   block, for call sites where a context manager would force a 300-line
+   reindent (cli.py's solve dispatch).
+ * `event(kind, **attrs)` - a zero-duration record.
+
+The module-level tracer is a process-wide singleton configured by
+`configure(path)` (the CLI's `--telemetry-dir` does this).  When NOT
+configured every call is a cheap no-op - `span()` yields a throwaway
+dict without allocating ids or touching any lock - so instrumented code
+paths cost nothing in untraced runs (bench.py pins the traced overhead
+itself at <= 2%).
+
+Cross-thread linkage: parenthood is thread-local (a scheduler-worker
+span is not a child of whatever the HTTP thread had open).  Cross-thread
+stories - one serve request enqueued on thread A and executed on thread
+B - are stitched by shared ATTRIBUTES instead (`request_id` /
+`request_ids`), which `wavetpu trace-report --request` joins on.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import os
+import sys
+import threading
+import time
+from typing import Optional
+
+
+class Tracer:
+    """JSONL span writer bound to one output file (append mode)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f = open(path, "a", encoding="utf-8")
+        self._wlock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._prefix = f"{os.getpid():x}"
+        self._local = threading.local()
+
+    # -- ids / stack ---------------------------------------------------
+
+    def new_id(self) -> str:
+        return f"{self._prefix}-{next(self._ids)}"
+
+    def _stack(self):
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def current_span_id(self) -> Optional[str]:
+        st = self._stack()
+        return st[-1] if st else None
+
+    # -- emission ------------------------------------------------------
+
+    def _write(self, record: dict) -> None:
+        # Best-effort: telemetry must never crash the run it observes.
+        # OSError = disk full / EIO; ValueError = file closed by a
+        # concurrent disable() while another thread still held a span.
+        line = json.dumps(record, default=str)
+        try:
+            with self._wlock:
+                self._f.write(line + "\n")
+                self._f.flush()
+        except (OSError, ValueError):
+            pass
+
+    def begin(self, kind: str, attrs: dict, /) -> dict:
+        """Open a span; returns the handle `end()` wants.  Also opens a
+        matching jax.profiler.TraceAnnotation when jax is already loaded
+        so application spans land in `--profile` device traces."""
+        annotation = None
+        jax = sys.modules.get("jax")
+        if jax is not None:
+            try:
+                annotation = jax.profiler.TraceAnnotation(kind)
+                annotation.__enter__()
+            except Exception:
+                annotation = None
+        handle = {
+            "kind": kind,
+            "span_id": self.new_id(),
+            "parent_id": self.current_span_id(),
+            "t_start": time.time(),
+            "_t0": time.perf_counter(),
+            "_annotation": annotation,
+            "attrs": attrs,
+        }
+        self._stack().append(handle["span_id"])
+        return handle
+
+    def end(self, handle: dict, **extra_attrs) -> None:
+        t0 = handle.pop("_t0", None)
+        if t0 is None:
+            # Already ended: a crash-path end_span can race the normal
+            # end on the same handle (supervisor's except handler).
+            # Ending twice must not raise (it would mask the original
+            # exception) or emit a duplicate record.
+            return
+        st = self._stack()
+        if st and st[-1] == handle["span_id"]:
+            st.pop()
+        elif handle["span_id"] in st:  # unbalanced begin/end: recover
+            st.remove(handle["span_id"])
+        annotation = handle.pop("_annotation", None)
+        if annotation is not None:
+            try:
+                annotation.__exit__(None, None, None)
+            except Exception:
+                pass
+        handle["attrs"] = dict(handle["attrs"], **extra_attrs)
+        dur = time.perf_counter() - t0
+        self._write({
+            "type": "span",
+            "kind": handle["kind"],
+            "span_id": handle["span_id"],
+            "parent_id": handle["parent_id"],
+            "thread": threading.current_thread().name,
+            "t_start": round(handle["t_start"], 6),
+            "dur_s": round(dur, 6),
+            "attrs": handle["attrs"],
+        })
+
+    @contextlib.contextmanager
+    def span(self, kind: str, /, **attrs):
+        handle = self.begin(kind, attrs)
+        try:
+            yield handle["attrs"]
+        finally:
+            self.end(handle)
+
+    def event(self, kind: str, /, **attrs) -> None:
+        self._write({
+            "type": "event",
+            "kind": kind,
+            "span_id": self.new_id(),
+            "parent_id": self.current_span_id(),
+            "thread": threading.current_thread().name,
+            "t_start": round(time.time(), 6),
+            "attrs": attrs,
+        })
+
+    def close(self) -> None:
+        with self._wlock:
+            if not self._f.closed:
+                self._f.close()
+
+
+# ------------------------------------------------- module-level tracer
+
+_tracer: Optional[Tracer] = None
+_config_lock = threading.Lock()
+
+
+def configure(path: str) -> Tracer:
+    """Start (or replace) the process tracer, writing JSONL to `path`."""
+    global _tracer
+    with _config_lock:
+        if _tracer is not None:
+            _tracer.close()
+        _tracer = Tracer(path)
+        return _tracer
+
+
+def disable() -> None:
+    global _tracer
+    with _config_lock:
+        if _tracer is not None:
+            _tracer.close()
+        _tracer = None
+
+
+def get_tracer() -> Optional[Tracer]:
+    return _tracer
+
+
+def enabled() -> bool:
+    return _tracer is not None
+
+
+@contextlib.contextmanager
+def span(kind: str, /, **attrs):
+    """Module-level span: no-op (fresh throwaway attrs dict) when no
+    tracer is configured, so instrumented paths cost nothing untraced."""
+    t = _tracer
+    if t is None:
+        yield attrs
+        return
+    with t.span(kind, **attrs) as a:
+        yield a
+
+
+def begin_span(kind: str, /, **attrs) -> Optional[dict]:
+    t = _tracer
+    return None if t is None else t.begin(kind, attrs)
+
+
+def end_span(handle: Optional[dict], **extra_attrs) -> None:
+    t = _tracer
+    if t is not None and handle is not None:
+        t.end(handle, **extra_attrs)
+
+
+def event(kind: str, /, **attrs) -> None:
+    t = _tracer
+    if t is not None:
+        t.event(kind, **attrs)
+
+
+def new_id() -> Optional[str]:
+    """A fresh id in the tracer's namespace (request correlation), or
+    None untraced."""
+    t = _tracer
+    return None if t is None else t.new_id()

@@ -861,7 +861,24 @@ def supervise(problem, spec: PathSpec, opts: SupervisorOptions,
     import jax
     import numpy as np
 
+    from wavetpu.obs import metrics as obs_metrics
+    from wavetpu.obs import tracing
     from wavetpu.run import faults, health
+
+    # Supervisor telemetry (docs/observability.md): counters in the
+    # process registry plus structured spans when --telemetry-dir is on.
+    # Chunk spans carry {start, end, length}; checkpoint spans carry the
+    # step they persist - so a trace's chunk boundaries are auditable
+    # against the rotation entries on disk.
+    c_chunks = obs_metrics.supervisor_counter(
+        "chunks_total", "chunk programs executed")
+    c_ckpts = obs_metrics.supervisor_counter(
+        "checkpoints_total", "rotation entries written")
+    c_retries = obs_metrics.supervisor_counter(
+        "retries_total", "watchdog auto-retries taken")
+    c_trips = obs_metrics.supervisor_counter(
+        "watchdog_trips_total", "numerical-health check failures")
+    g_step = obs_metrics.supervisor_step_gauge()
 
     path = _Path(problem, spec)
     is_main = jax.process_index() == 0
@@ -894,69 +911,133 @@ def supervise(problem, spec: PathSpec, opts: SupervisorOptions,
             t0 = time.perf_counter()
             path.save(rot, state, cur)
             ckpts += 1
+            c_ckpts.inc()
             overhead_s += time.perf_counter() - t0
 
-    with _SignalGuard(opts.handle_signals) as sig:
-        while True:
-            if state is None:
-                b = min(T, 1 + L)
-                state, a, r, i_s, s_s = path.first(b)
-                abs_full[: b + 1] = a
-                rel_full[: b + 1] = r
-                init_s += i_s
-                solve_s += s_s
-                marched += b
-                cur = b
-            elif cur < T:
-                length = min(L, T - cur)
-                state, a, r, s_s, c_s = path.chunk(state, cur, length)
-                abs_full[cur + 1: cur + length + 1] = a
-                rel_full[cur + 1: cur + length + 1] = r
-                init_s += c_s
-                solve_s += s_s
-                marched += length
-                cur += length
-            # ---- chunk-boundary bookkeeping at layer `cur` ----
-            if hook is not None:
-                state = hook(state, cur)
-            t0 = time.perf_counter()
-            ok = True
-            if opts.watchdog:
-                amax = health.state_amax(path.health_arrays(state))
-                ok = health.healthy(amax, opts.max_amp)
-            if not ok:
-                latest = rot.latest_path()
-                if retries_used < opts.retries:
-                    # Transient-fault model: reload the last-good
-                    # checkpoint (or restart from scratch if none yet)
-                    # and re-run the tripped chunk.
-                    retries_used += 1
-                    if latest is None:
-                        state, cur = None, None
-                    else:
-                        state, cur = path.load(latest)
-                    overhead_s += time.perf_counter() - t0
-                    continue
-                status = "watchdog"
-                if latest is not None:
-                    state, cur = path.load(latest)
+    march_span = tracing.begin_span(
+        "supervisor.march", n=problem.N, timesteps=T, chunk_length=L,
+        solver_kind=path.kind, start_step=0 if cur is None else cur,
+    )
+    chunk_span = None
+    try:
+        with _SignalGuard(opts.handle_signals) as sig:
+            while True:
+                chunk_ran = True
+                if state is None:
+                    b = min(T, 1 + L)
+                    chunk_span = tracing.begin_span(
+                        "supervisor.chunk", start=0, end=b, length=b,
+                        first=True,
+                    )
+                    state, a, r, i_s, s_s = path.first(b)
+                    tracing.end_span(
+                        chunk_span, solve_seconds=round(s_s, 6),
+                        compile_seconds=round(i_s, 6),
+                    )
+                    chunk_span = None
+                    abs_full[: b + 1] = a
+                    rel_full[: b + 1] = r
+                    init_s += i_s
+                    solve_s += s_s
+                    marched += b
+                    cur = b
+                elif cur < T:
+                    length = min(L, T - cur)
+                    chunk_span = tracing.begin_span(
+                        "supervisor.chunk", start=cur, end=cur + length,
+                        length=length, first=False,
+                    )
+                    state, a, r, s_s, c_s = path.chunk(state, cur, length)
+                    tracing.end_span(
+                        chunk_span, solve_seconds=round(s_s, 6),
+                        compile_seconds=round(c_s, 6),
+                    )
+                    chunk_span = None
+                    abs_full[cur + 1: cur + length + 1] = a
+                    rel_full[cur + 1: cur + length + 1] = r
+                    init_s += c_s
+                    solve_s += s_s
+                    marched += length
+                    cur += length
                 else:
-                    state, cur = None, 0
-                abs_full[cur + 1:] = 0.0
-                rel_full[cur + 1:] = 0.0
+                    # Injected state already at (or past) the target layer:
+                    # no chunk program ran this iteration, so the counter
+                    # must not claim one (the chunks-equal-spans audit).
+                    chunk_ran = False
+                if chunk_ran:
+                    c_chunks.inc()
+                g_step.set(cur)
+                # ---- chunk-boundary bookkeeping at layer `cur` ----
+                if hook is not None:
+                    state = hook(state, cur)
+                t0 = time.perf_counter()
+                ok = True
+                if opts.watchdog:
+                    with tracing.span("supervisor.health", step=cur) as sp:
+                        amax = health.state_amax(path.health_arrays(state))
+                        ok = health.healthy(amax, opts.max_amp)
+                        sp["amax"] = amax
+                        sp["ok"] = ok
+                if not ok:
+                    c_trips.inc()
+                    latest = rot.latest_path()
+                    if retries_used < opts.retries:
+                        # Transient-fault model: reload the last-good
+                        # checkpoint (or restart from scratch if none yet)
+                        # and re-run the tripped chunk.
+                        retries_used += 1
+                        c_retries.inc()
+                        tracing.event(
+                            "supervisor.retry", step=cur, amax=amax,
+                            retry=retries_used,
+                            reload=latest or "from-scratch",
+                        )
+                        if latest is None:
+                            state, cur = None, None
+                        else:
+                            state, cur = path.load(latest)
+                        overhead_s += time.perf_counter() - t0
+                        continue
+                    status = "watchdog"
+                    tracing.event(
+                        "supervisor.watchdog_halt", step=cur, amax=amax
+                    )
+                    if latest is not None:
+                        state, cur = path.load(latest)
+                    else:
+                        state, cur = None, 0
+                    abs_full[cur + 1:] = 0.0
+                    rel_full[cur + 1:] = 0.0
+                    overhead_s += time.perf_counter() - t0
+                    break
+                with tracing.span(
+                    "supervisor.checkpoint", step=cur
+                ) as sp:
+                    sp["path"] = path.save(rot, state, cur)
+                ckpts += 1
+                c_ckpts.inc()
                 overhead_s += time.perf_counter() - t0
-                break
-            path.save(rot, state, cur)
-            ckpts += 1
-            overhead_s += time.perf_counter() - t0
-            if cur >= T:
-                break
-            if sig.triggered is not None:
-                status = "preempted"
-                abs_full[cur + 1:] = 0.0
-                rel_full[cur + 1:] = 0.0
-                break
-
+                if cur >= T:
+                    break
+                if sig.triggered is not None:
+                    status = "preempted"
+                    tracing.event("supervisor.preempted", step=cur,
+                                  signal=sig.triggered)
+                    abs_full[cur + 1:] = 0.0
+                    rel_full[cur + 1:] = 0.0
+                    break
+    except BaseException as e:
+        # A crash mid-march (XLA OOM, device error) must still emit
+        # the open chunk/march spans - they are the telemetry meant
+        # to explain the crash - and must not leave their ids on the
+        # thread-local parent stack for later spans to adopt.
+        tracing.end_span(chunk_span, error=repr(e))
+        tracing.end_span(march_span, status="error", error=repr(e))
+        raise
+    tracing.end_span(
+        march_span, status=status, final_step=cur or 0,
+        checkpoints=ckpts, retries=retries_used,
+    )
     result = path.to_result(
         state, abs_full, rel_full, cur or 0, init_s, solve_s, marched
     )

@@ -11,6 +11,7 @@ into batches (shape bucketing + max-batch/max-wait dynamic batching);
 from wavetpu.serve.engine import ProgramKey, ServeEngine
 from wavetpu.serve.scheduler import (
     DynamicBatcher,
+    QueueFullError,
     ServeMetrics,
     SolveRequest,
 )
@@ -18,6 +19,7 @@ from wavetpu.serve.scheduler import (
 __all__ = [
     "DynamicBatcher",
     "ProgramKey",
+    "QueueFullError",
     "ServeEngine",
     "ServeMetrics",
     "SolveRequest",

@@ -7,10 +7,19 @@ Endpoints (contract in docs/serving.md):
                  Concurrent requests with the same program identity are
                  coalesced into one batched XLA solve (scheduler.py);
                  each response carries its lane's report plus batch
-                 context (occupancy, batched-or-fallback, path).
-  GET /healthz   liveness: {"status": "ok", ...}.
+                 context (occupancy, batched-or-fallback, path).  With
+                 --max-queue set, a full queue answers 429 (bounded-
+                 queue backpressure) instead of building latency.
+  GET /healthz   liveness AND wedge detection: {"status": "ok",
+                 "uptime_seconds", "draining", "last_batch_age_seconds"}
+                 - a load balancer distinguishes idle (no traffic, age
+                 null/stale but draining false) from wedged.
   GET /metrics   request counts, batch occupancy, p50/p95 latency,
-                 aggregate Gcell/s, program-cache and fallback state.
+                 aggregate Gcell/s, queue depth/rejections, program-
+                 cache and fallback state.  Content-negotiated: the
+                 default is the historical JSON snapshot; `Accept:
+                 text/plain` serves Prometheus text exposition from the
+                 same registry cut (docs/observability.md).
 
 Request fields: N (required), Np, Lx, Ly, Lz (floats or "pi"), T,
 timesteps, phase (initial time phase, default 2*pi), steps (stop layer,
@@ -45,19 +54,23 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence, Tuple
 
 from wavetpu.core.problem import Problem, parse_length
+from wavetpu.obs import tracing
 
 _USAGE = (
     "usage: wavetpu serve [--host H] [--port P] [--max-batch B] "
     "[--max-wait-ms MS] [--bucket-sizes 1,2,4,8] [--max-programs M] "
-    "[--length-bucket-steps Q] [--kernel auto|roll|pallas] "
+    "[--length-bucket-steps Q] [--max-queue Q] "
+    "[--kernel auto|roll|pallas] "
     "[--no-errors] [--max-amp X] [--no-watchdog] "
-    "[--warmup N,TIMESTEPS[,K]] [--platform NAME] [--version]"
+    "[--warmup N,TIMESTEPS[,K]] [--platform NAME] "
+    "[--telemetry-dir DIR] [--version]"
 )
 
 _KNOWN = (
     "host", "port", "max-batch", "max-wait-ms", "bucket-sizes",
-    "max-programs", "length-bucket-steps", "kernel", "no-errors",
-    "max-amp", "no-watchdog", "warmup", "platform", "version",
+    "max-programs", "length-bucket-steps", "max-queue", "kernel",
+    "no-errors", "max-amp", "no-watchdog", "warmup", "platform",
+    "telemetry-dir", "version",
 )
 _VALUELESS = ("no-errors", "no-watchdog", "version")
 
@@ -279,22 +292,48 @@ class _Handler(BaseHTTPRequestHandler):
         return self.server.wavetpu_state
 
     def _send(self, code: int, payload: dict) -> None:
-        body = json.dumps(payload).encode()
+        self._send_text(code, json.dumps(payload), "application/json")
+
+    def _send_text(self, code: int, text: str, content_type: str) -> None:
+        body = text.encode()
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
 
     def do_GET(self) -> None:  # noqa: N802 (stdlib contract)
         if self.path == "/healthz":
+            age = self.state.metrics.last_batch_age()
             self._send(200, {
                 "status": "ok",
                 "uptime_seconds": round(
                     time.time() - self.state.started, 3
                 ),
+                "draining": self.state.draining,
+                "last_batch_age_seconds": (
+                    None if age is None else round(age, 3)
+                ),
             })
         elif self.path == "/metrics":
+            accept = self.headers.get("Accept", "") or ""
+            # A client that lists application/json at all (e.g. the
+            # axios default "application/json, text/plain, */*") gets
+            # JSON; Prometheus scrapers send text/plain or openmetrics
+            # without it.
+            wants_text = (
+                "application/json" not in accept
+                and ("text/plain" in accept or "openmetrics" in accept)
+            )
+            if wants_text:
+                # Prometheus text exposition - one consistent registry
+                # cut (scrape config: docs/observability.md).
+                self._send_text(
+                    200,
+                    self.state.metrics.registry.render_prometheus(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+                return
             snap = self.state.metrics.snapshot()
             snap["program_cache"] = self.state.engine.cache_stats()
             self._send(200, snap)
@@ -305,14 +344,34 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path != "/solve":
             self._send(404, {"status": "error", "error": "not found"})
             return
+        # One `serve.request` span per request: its wall time is the
+        # end-to-end latency; the scheduler-thread `serve.batch` span
+        # that carried it joins on the shared request_id attribute
+        # (trace-report --request ID stitches the two).
+        rid = tracing.new_id()
+        span = tracing.begin_span("serve.request", request_id=rid)
+        code = None
+        try:
+            code, payload = self._handle_solve(rid)
+        finally:
+            # An unexpected handler exception must not leak the open
+            # span (it would poison this thread's parent stack and
+            # vanish from the trace).
+            tracing.end_span(
+                span, status="exception" if code is None else code
+            )
+        self._send(code, payload)
+
+    def _handle_solve(self, rid) -> Tuple[int, dict]:
+        from wavetpu.serve.scheduler import QueueFullError
+
         st = self.state
         if st.draining:
             st.metrics.observe_response(False)
-            self._send(503, {
+            return 503, {
                 "status": "error",
                 "error": "server draining (shutting down)",
-            })
-            return
+            }
         t0 = time.monotonic()
         try:
             length = int(self.headers.get("Content-Length", "0"))
@@ -320,33 +379,43 @@ class _Handler(BaseHTTPRequestHandler):
             req = parse_solve_request(body, st.default_kernel)
         except (ValueError, TypeError, json.JSONDecodeError) as e:
             st.metrics.observe_response(False)
-            self._send(400, {"status": "error", "error": str(e)})
-            return
+            return 400, {"status": "error", "error": str(e)}
         try:
-            fut = st.batcher.submit(req)
+            fut = st.batcher.submit(req, request_id=rid)
+        except QueueFullError as e:
+            # Bounded-queue backpressure: shed load NOW instead of
+            # stacking latency the client will time out on anyway.
+            # (Sub-millisecond rejections stay out of the latency
+            # reservoir - they would drag p50 to ~0 under overload.)
+            st.metrics.observe_response(False)
+            return 429, {"status": "error", "error": str(e)}
+        except Exception as e:
+            # A closed batcher ("batcher is closed" during shutdown)
+            # gets its 500 JSON, not a connection reset - the
+            # historical handler's contract.
+            st.metrics.observe_response(False)
+            return 500, {"status": "error", "error": str(e)}
+        try:
             lane_result, lane_error, batch_info = fut.result(
                 st.request_timeout
             )
         except Exception as e:
             st.metrics.observe_response(False)
-            self._send(500, {"status": "error", "error": str(e)})
-            return
+            return 500, {"status": "error", "error": str(e)}
         finally:
             st.metrics.observe_latency(time.monotonic() - t0)
         if lane_error is not None:
             st.metrics.observe_response(False)
-            self._send(422, {
+            return 422, {
                 "status": "error",
                 "error": lane_error,
                 "batch": batch_info,
-            })
-            return
+            }
         errors_computed = (
             st.engine.compute_errors and req.lane.c2tau2_field is None
         )
         st.metrics.observe_response(True)
-        self._send(200, _ok_payload(lane_result, batch_info,
-                                    errors_computed))
+        return 200, _ok_payload(lane_result, batch_info, errors_computed)
 
 
 def build_server(
@@ -362,25 +431,30 @@ def build_server(
     default_kernel: str = "auto",
     interpret: Optional[bool] = None,
     length_bucket_steps: Optional[int] = None,
+    max_queue: Optional[int] = None,
 ) -> Tuple[ThreadingHTTPServer, ServerState]:
     """Assemble engine + batcher + HTTP server (port 0 = ephemeral; the
     bound port is `httpd.server_address[1]`).  Returned httpd is not yet
     serving - call `serve_forever()` (main does) or drive it from a
     thread (tests do).  `length_bucket_steps` turns on stop-length
     bucketing in the scheduler (masked-lane FLOP control - see
-    DynamicBatcher)."""
+    DynamicBatcher); `max_queue` bounds the request queue (full ->
+    429).  Engine and metrics share ONE MetricsRegistry so the
+    Prometheus exposition at /metrics is a single consistent cut."""
+    from wavetpu.obs.registry import MetricsRegistry
     from wavetpu.serve.engine import ServeEngine
     from wavetpu.serve.scheduler import DynamicBatcher, ServeMetrics
 
+    registry = MetricsRegistry()
     engine = ServeEngine(
         bucket_sizes=bucket_sizes, max_programs=max_programs,
         compute_errors=compute_errors, interpret=interpret,
-        watchdog=watchdog, max_amp=max_amp,
+        watchdog=watchdog, max_amp=max_amp, registry=registry,
     )
-    metrics = ServeMetrics()
+    metrics = ServeMetrics(registry=registry)
     batcher = DynamicBatcher(
         engine, metrics=metrics, max_batch=max_batch, max_wait=max_wait,
-        length_bucket_steps=length_bucket_steps,
+        length_bucket_steps=length_bucket_steps, max_queue=max_queue,
     )
     httpd = ThreadingHTTPServer((host, port), _Handler)
     httpd.wavetpu_state = ServerState(
@@ -417,12 +491,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             int(flags["length-bucket-steps"])
             if "length-bucket-steps" in flags else None
         )
+        max_queue = (
+            int(flags["max-queue"]) if "max-queue" in flags else None
+        )
         max_amp = float(flags["max-amp"]) if "max-amp" in flags else None
         kernel = flags.get("kernel", "auto")
         if kernel not in ("auto", "roll", "pallas"):
             raise ValueError(
                 f"--kernel must be auto|roll|pallas, got {kernel}"
             )
+        warmup_parts = None
+        if "warmup" in flags:
+            warmup_parts = [int(x) for x in flags["warmup"].split(",")]
+            if len(warmup_parts) not in (2, 3):
+                raise ValueError("--warmup wants N,TIMESTEPS[,K]")
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         print(_USAGE, file=sys.stderr)
@@ -442,46 +524,64 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         compute_errors="no-errors" not in flags,
         watchdog="no-watchdog" not in flags, max_amp=max_amp,
         default_kernel=kernel, length_bucket_steps=length_bucket_steps,
+        max_queue=max_queue,
     )
-    if "warmup" in flags:
-        parts = [int(x) for x in flags["warmup"].split(",")]
-        if len(parts) not in (2, 3):
-            print("error: --warmup wants N,TIMESTEPS[,K]", file=sys.stderr)
-            return 2
-        wp = Problem(N=parts[0], timesteps=parts[1])
-        k = parts[2] if len(parts) == 3 else 1
-        path = "kfused" if k > 1 else (
-            "pallas" if jax.default_backend() == "tpu" else "roll"
-        )
-        warmed = state.engine.warmup(wp, path=path, k=max(k, 2))
-        print(f"warmed buckets {warmed} for N={wp.N} path={path}")
-
-    bound = httpd.server_address
-    print(
-        f"wavetpu serve on http://{bound[0]}:{bound[1]} "
-        f"(backend={jax.default_backend()}, max_batch="
-        f"{state.batcher.max_batch}, max_wait="
-        f"{state.batcher.max_wait * 1e3:g}ms, buckets="
-        f"{state.engine.bucket_sizes})"
-    )
-    import signal
-
-    def _shutdown(signum, frame):
-        # Graceful drain: refuse new /solve (503) immediately, stop the
-        # accept loop, and let the finally-block flush what is queued.
-        state.draining = True
-        threading.Thread(target=httpd.shutdown, daemon=True).start()
-
-    signal.signal(signal.SIGTERM, _shutdown)
-    signal.signal(signal.SIGINT, _shutdown)
+    telemetry = None
+    serving = False
     try:
+        if "telemetry-dir" in flags:
+            # Tracing (request/batch/compile spans) + heartbeat snapshots
+            # of THIS server's registry, tailable while it serves.
+            from wavetpu.obs import telemetry as _tel
+
+            telemetry = _tel.start(
+                flags["telemetry-dir"], registry=state.metrics.registry
+            )
+            print(f"telemetry: {flags['telemetry-dir']}")
+        if warmup_parts is not None:
+            wp = Problem(N=warmup_parts[0], timesteps=warmup_parts[1])
+            k = warmup_parts[2] if len(warmup_parts) == 3 else 1
+            path = "kfused" if k > 1 else (
+                "pallas" if jax.default_backend() == "tpu" else "roll"
+            )
+            warmed = state.engine.warmup(wp, path=path, k=max(k, 2))
+            print(f"warmed buckets {warmed} for N={wp.N} path={path}")
+
+        bound = httpd.server_address
+        print(
+            f"wavetpu serve on http://{bound[0]}:{bound[1]} "
+            f"(backend={jax.default_backend()}, max_batch="
+            f"{state.batcher.max_batch}, max_wait="
+            f"{state.batcher.max_wait * 1e3:g}ms, buckets="
+            f"{state.engine.bucket_sizes})"
+        )
+        import signal
+
+        def _shutdown(signum, frame):
+            # Graceful drain: refuse new /solve (503) immediately, stop
+            # the accept loop, and let the finally block flush what is
+            # queued.
+            state.draining = True
+            threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+        signal.signal(signal.SIGTERM, _shutdown)
+        signal.signal(signal.SIGINT, _shutdown)
+        serving = True
         httpd.serve_forever()
     finally:
-        # drain=True resolves every outstanding future with its RESULT
-        # (queued batches are flushed through the engine) instead of
-        # erroring them; the generous timeout covers a full batch solve.
-        state.batcher.close(timeout=120.0, drain=True)
+        # Once serving, drain=True resolves every outstanding future
+        # with its RESULT (queued batches are flushed through the
+        # engine) instead of erroring them; the generous timeout covers
+        # a full batch solve.  Before serve started (a warmup compile
+        # failure, a bad telemetry dir) there is nothing to drain -
+        # close fast, and never leak the batcher worker thread, the
+        # listening socket, or a running heartbeat daemon / bound
+        # process tracer to an in-process caller.
+        state.batcher.close(timeout=120.0 if serving else 5.0,
+                            drain=serving)
         httpd.server_close()
+        if telemetry is not None:
+            telemetry.stop()
     print("wavetpu serve: shut down cleanly (drained)")
     return 0
 

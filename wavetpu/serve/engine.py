@@ -24,12 +24,15 @@ not sink the batch.
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 from wavetpu.core.problem import Problem
 from wavetpu.ensemble import batched as ensemble
 from wavetpu.ensemble import sharded as ens_sharded
+from wavetpu.obs import tracing
+from wavetpu.obs.registry import MetricsRegistry
 from wavetpu.run import health
 
 
@@ -87,6 +90,7 @@ class ServeEngine:
         watchdog: bool = True,
         max_amp: Optional[float] = None,
         block_x: Optional[int] = None,
+        registry: Optional[MetricsRegistry] = None,
     ):
         if not bucket_sizes or any(b < 1 for b in bucket_sizes):
             raise ValueError(f"bad bucket_sizes {bucket_sizes}")
@@ -99,16 +103,51 @@ class ServeEngine:
         self.watchdog = watchdog
         self.max_amp = max_amp
         self.block_x = block_x
+        # `build_server` passes the server's registry so cache and
+        # compile/execute metrics land in the same /metrics exposition
+        # as the scheduler's; a standalone engine gets its own.
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self._c_cache = self.registry.counter(
+            "wavetpu_program_cache_events_total",
+            "compiled-program cache events", ("event",),
+        )
+        self._h_compile = self.registry.histogram(
+            "wavetpu_serve_compile_seconds",
+            "batched-program build+compile time on cache miss",
+            buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                     120.0, 300.0),
+        )
+        self._h_execute = self.registry.histogram(
+            "wavetpu_serve_execute_seconds",
+            "batch solve wall time (warm=false includes this key's "
+            "first compile in the same request)", ("warm",),
+            buckets=(0.005, 0.025, 0.1, 0.25, 1.0, 2.5, 5.0, 10.0,
+                     30.0, 60.0, 120.0, 300.0),
+        )
         self._lock = threading.Lock()
         self._programs: "OrderedDict[ProgramKey, ensemble.EnsembleSolver]" = (
             OrderedDict()
         )
-        self.hits = 0
-        self.misses = 0
-        self.evictions = 0
         # path -> recorded fallback reason (never silent; surfaced in
         # /metrics so an operator sees WHICH path refused to vmap).
         self.fallbacks: dict = {}
+
+    # Cache hit/miss/eviction counts live in the registry counter - the
+    # single source of truth for the JSON and Prometheus /metrics views;
+    # these properties keep the historical attribute API readable.
+
+    @property
+    def hits(self) -> int:
+        return int(self._c_cache.value(event="hit"))
+
+    @property
+    def misses(self) -> int:
+        return int(self._c_cache.value(event="miss"))
+
+    @property
+    def evictions(self) -> int:
+        return int(self._c_cache.value(event="eviction"))
 
     @property
     def max_batch(self) -> int:
@@ -147,6 +186,19 @@ class ServeEngine:
         recorded lane-loop fallback.  `mesh` selects the sharded x
         batched composition (ensemble/sharded.py); a (mesh, bucket) pair
         is its own cached executable."""
+        return self._program(
+            problem, scheme, path, k, dtype_name, with_field, batch, mesh
+        )[0]
+
+    def _program(
+        self, problem: Problem, scheme: str, path: str, k: int,
+        dtype_name: str, with_field: bool, batch: int,
+        mesh: Optional[Tuple[int, int, int]] = None,
+    ):
+        """`program()` plus whether THIS call compiled - (prog, missed).
+        The bool is what warm-vs-cold execute attribution keys on;
+        diffing the shared `misses` counter instead would race with a
+        concurrent warmup taking a miss on a different key."""
         compute_errors = self.compute_errors and not with_field
         if mesh is not None:
             if scheme != "standard":
@@ -165,7 +217,7 @@ class ServeEngine:
                 self.fallbacks.setdefault(
                     f"mesh:{tuple(mesh)}:{path}", why
                 )
-                return None
+                return None, False
         else:
             ok, why = ensemble.vmap_capability(
                 path, k=k, interpret=self.interpret,
@@ -173,7 +225,7 @@ class ServeEngine:
             )
             if not ok:
                 self.fallbacks.setdefault(f"{scheme}:{path}", why)
-                return None
+                return None, False
         key = ProgramKey.for_batch(
             problem, scheme, path, k, dtype_name, with_field,
             compute_errors, batch, mesh,
@@ -182,32 +234,38 @@ class ServeEngine:
             prog = self._programs.get(key)
             if prog is not None:
                 self._programs.move_to_end(key)
-                self.hits += 1
-                return prog
-            self.misses += 1
+                self._c_cache.inc(event="hit")
+                return prog, False
+            self._c_cache.inc(event="miss")
         # Build + compile OUTSIDE the lock (XLA compiles can take
         # seconds; warmup from another thread must not serialize on it).
-        if mesh is not None:
-            prog = ens_sharded.ShardedEnsembleSolver(
-                problem, batch, mesh, dtype=self._dtype(dtype_name),
-                kernel=path, compute_errors=compute_errors,
-                interpret=self.interpret,
-            )
-        else:
-            prog = ensemble.EnsembleSolver(
-                problem, batch, dtype=self._dtype(dtype_name), path=path,
-                k=k, compute_errors=compute_errors,
-                interpret=self.interpret, block_x=self.block_x,
-                with_field=with_field, scheme=scheme,
-            )
-        prog.compile()
+        t0 = time.perf_counter()
+        with tracing.span(
+            "serve.compile", scheme=scheme, path=path, batch=batch,
+            n=problem.N, mesh=None if mesh is None else list(mesh),
+        ):
+            if mesh is not None:
+                prog = ens_sharded.ShardedEnsembleSolver(
+                    problem, batch, mesh, dtype=self._dtype(dtype_name),
+                    kernel=path, compute_errors=compute_errors,
+                    interpret=self.interpret,
+                )
+            else:
+                prog = ensemble.EnsembleSolver(
+                    problem, batch, dtype=self._dtype(dtype_name), path=path,
+                    k=k, compute_errors=compute_errors,
+                    interpret=self.interpret, block_x=self.block_x,
+                    with_field=with_field, scheme=scheme,
+                )
+            prog.compile()
+        self._h_compile.observe(time.perf_counter() - t0)
         with self._lock:
             self._programs[key] = prog
             self._programs.move_to_end(key)
             while len(self._programs) > self.max_programs:
                 self._programs.popitem(last=False)
-                self.evictions += 1
-        return prog
+                self._c_cache.inc(event="eviction")
+        return prog, True
 
     def warmup(
         self, problem: Problem, scheme: str = "standard",
@@ -257,38 +315,49 @@ class ServeEngine:
         touching its batchmates."""
         if not self.watchdog:
             return [None] * len(result.results)
-        # One fused pass per state array over the whole batch (B scalars
-        # to host), not B separate reductions.  The vmapped path hands
-        # us its raw batched outputs (no copy); the lane-loop fallback
-        # has separate per-lane arrays and pays one stack each.
-        if result.u_prev_batch is not None:
-            amaxes = [
-                health.guarded_amax_per_lane(batch)[: len(result.results)]
-                for batch in (result.u_prev_batch, result.u_cur_batch)
-            ]
-        else:
-            import jax.numpy as jnp
-
-            amaxes = [
-                health.guarded_amax_per_lane(
-                    jnp.stack([getattr(r, name) for r in result.results])
-                )
-                for name in ("u_prev", "u_cur")
-            ]
-        out = []
-        for amax in map(max, zip(*amaxes)):
-            amax = float(amax)
-            if health.healthy(amax, self.max_amp):
-                out.append(None)
+        # The context manager (not begin/end) so a raising reduction
+        # still closes the span: a leaked span id would become every
+        # later batch span's parent on this worker thread.
+        with tracing.span(
+            "serve.watchdog", lanes=len(result.results)
+        ) as sp:
+            # One fused pass per state array over the whole batch (B
+            # scalars to host), not B separate reductions.  The vmapped
+            # path hands us its raw batched outputs (no copy); the
+            # lane-loop fallback has separate per-lane arrays and pays
+            # one stack each.
+            if result.u_prev_batch is not None:
+                amaxes = [
+                    health.guarded_amax_per_lane(
+                        batch
+                    )[: len(result.results)]
+                    for batch in (result.u_prev_batch, result.u_cur_batch)
+                ]
             else:
-                bound = (
-                    health.DEFAULT_AMP_BOUND
-                    if self.max_amp is None else self.max_amp
-                )
-                out.append(
-                    f"numerical-health trip: guarded amax {amax:g} "
-                    f"exceeds bound {bound:g} (NaN/Inf count as inf)"
-                )
+                import jax.numpy as jnp
+
+                amaxes = [
+                    health.guarded_amax_per_lane(
+                        jnp.stack([getattr(r, name)
+                                   for r in result.results])
+                    )
+                    for name in ("u_prev", "u_cur")
+                ]
+            out = []
+            for amax in map(max, zip(*amaxes)):
+                amax = float(amax)
+                if health.healthy(amax, self.max_amp):
+                    out.append(None)
+                else:
+                    bound = (
+                        health.DEFAULT_AMP_BOUND
+                        if self.max_amp is None else self.max_amp
+                    )
+                    out.append(
+                        f"numerical-health trip: guarded amax {amax:g} "
+                        f"exceeds bound {bound:g} (NaN/Inf count as inf)"
+                    )
+            sp["tripped"] = sum(1 for o in out if o is not None)
         return out
 
     def solve(
@@ -305,26 +374,46 @@ class ServeEngine:
         with_field = any(lane.c2tau2_field is not None for lane in lanes)
         compute_errors = self.compute_errors and not with_field
         bucket = self.bucket_for(len(lanes))
-        prog = self.program(
+        # Warm-vs-cold attribution: a solve whose program lookup had to
+        # compile is this key's first-request latency, not its steady
+        # state; the histogram label keeps the two populations apart.
+        # A capability-refused key runs the lane-loop fallback, whose
+        # per-lane compile behavior is jax-cache-dependent - its own
+        # label value, so fallback outliers never pollute either the
+        # warm or the cold batched population.
+        prog, missed = self._program(
             problem, scheme, path, k, dtype_name, with_field, bucket, mesh
         )
-        if mesh is not None:
-            result = ens_sharded.solve_ensemble_sharded(
-                problem, lanes, mesh_shape=mesh,
-                dtype=self._dtype(dtype_name), kernel=path,
-                compute_errors=compute_errors, interpret=self.interpret,
-                pad_to=bucket if prog is not None else None,
-                solver=prog,
-            )
-        else:
-            result = ensemble.solve_ensemble(
-                problem, lanes, dtype=self._dtype(dtype_name),
-                scheme=scheme, path=path, k=k,
-                compute_errors=compute_errors,
-                interpret=self.interpret, block_x=self.block_x,
-                pad_to=bucket if prog is not None else None,
-                solver=prog,
-            )
+        warm = prog is not None and not missed
+        with tracing.span(
+            "serve.execute", scheme=scheme, path=path,
+            occupancy=len(lanes), bucket=bucket, warm=warm,
+        ) as sp:
+            if mesh is not None:
+                result = ens_sharded.solve_ensemble_sharded(
+                    problem, lanes, mesh_shape=mesh,
+                    dtype=self._dtype(dtype_name), kernel=path,
+                    compute_errors=compute_errors, interpret=self.interpret,
+                    pad_to=bucket if prog is not None else None,
+                    solver=prog,
+                )
+            else:
+                result = ensemble.solve_ensemble(
+                    problem, lanes, dtype=self._dtype(dtype_name),
+                    scheme=scheme, path=path, k=k,
+                    compute_errors=compute_errors,
+                    interpret=self.interpret, block_x=self.block_x,
+                    pad_to=bucket if prog is not None else None,
+                    solver=prog,
+                )
+            sp["batched"] = result.batched
+        self._h_execute.observe(
+            result.solve_seconds,
+            warm=(
+                "fallback" if prog is None
+                else "true" if warm else "false"
+            ),
+        )
         if not result.batched and result.fallback_reason:
             self.fallbacks.setdefault(
                 f"{scheme}:{result.path}", result.fallback_reason

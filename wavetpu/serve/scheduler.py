@@ -19,7 +19,14 @@ batches simulations the same way an LLM server batches prompts):
 
 `ServeMetrics` is the shared counter block /metrics renders: request and
 batch counts, occupancy, latency percentiles over a sliding reservoir,
-and aggregate Gcell/s across all served lanes.
+and aggregate Gcell/s across all served lanes.  Since the unified-
+telemetry round it WRITES THROUGH an `obs.registry.MetricsRegistry`
+(one per server, so test servers never share counters): the JSON
+snapshot keeps its exact historical fields while the same state renders
+as Prometheus text exposition under `Accept: text/plain`, and every
+batch emits a `serve.batch` span (occupancy, padding waste, queue
+waits, request ids) into the structured trace when `--telemetry-dir`
+is on.
 """
 
 from __future__ import annotations
@@ -30,10 +37,18 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import Future
-from typing import List, Optional, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 from wavetpu.core.problem import Problem
 from wavetpu.ensemble.batched import LaneSpec
+from wavetpu.obs import tracing
+from wavetpu.obs.registry import MetricsRegistry
+from wavetpu.obs.report import percentile_nearest_rank
+
+
+class QueueFullError(RuntimeError):
+    """`submit()` refused: the bounded request queue is at capacity.
+    The HTTP layer maps this to 429 (backpressure, not failure)."""
 
 
 @dataclasses.dataclass
@@ -66,77 +81,162 @@ class SolveRequest:
         )
 
 
-class ServeMetrics:
-    """Thread-safe counters for /metrics (shared by scheduler + api)."""
+_LATENCY_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    30.0, 60.0, 120.0, 300.0,
+)
+_OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32)
 
-    def __init__(self, latency_window: int = 1024):
-        self._lock = threading.Lock()
+
+class ServeMetrics:
+    """Thread-safe counters for /metrics (shared by scheduler + api).
+
+    All state lives in an `obs.registry.MetricsRegistry` (own one by
+    default; `build_server` passes a shared per-server registry so the
+    engine's program-cache counters land in the same Prometheus
+    exposition).  `snapshot()` takes the REGISTRY lock across the whole
+    read - including the exact-percentile latency reservoir, which is
+    guarded by the same lock - so a scrape is one consistent cut and can
+    never see, e.g., `responses_ok` ahead of `requests_total` or a torn
+    occupancy mean.  (The pre-registry ServeMetrics held its own lock in
+    snapshot() but each observe_* released it between related fields;
+    one registry-wide lock closes that audit for good.)
+    """
+
+    def __init__(self, latency_window: int = 1024,
+                 registry: Optional[MetricsRegistry] = None):
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
         self.started = time.time()
-        self.requests_total = 0
-        self.responses_ok = 0
-        self.responses_error = 0
-        self.batches_total = 0
-        self.occupancy_sum = 0
-        self.occupancy_max = 0
-        self.fallback_batches = 0
-        self.cells_total = 0.0
-        self.solve_seconds_total = 0.0
+        r = self.registry
+        self._requests = r.counter(
+            "wavetpu_serve_requests_total", "solve requests accepted"
+        )
+        self._responses = r.counter(
+            "wavetpu_serve_responses_total", "responses by outcome",
+            ("status",),
+        )
+        self._rejected = r.counter(
+            "wavetpu_serve_rejected_total",
+            "requests rejected with 429 (bounded queue full)",
+        )
+        self._batches = r.counter(
+            "wavetpu_serve_batches_total", "batches executed"
+        )
+        self._occupancy = r.histogram(
+            "wavetpu_serve_batch_occupancy", "real lanes per batch",
+            buckets=_OCCUPANCY_BUCKETS,
+        )
+        self._occupancy_max = r.gauge(
+            "wavetpu_serve_batch_occupancy_max",
+            "largest batch occupancy seen",
+        )
+        self._padding = r.counter(
+            "wavetpu_serve_padding_lanes_total",
+            "masked padding lanes marched (bucket size - occupancy)",
+        )
+        self._fallbacks = r.counter(
+            "wavetpu_serve_fallback_batches_total",
+            "batches served by the lane-loop fallback",
+        )
+        self._cells = r.counter(
+            "wavetpu_serve_cells_total", "cell updates served"
+        )
+        self._solve_seconds = r.counter(
+            "wavetpu_serve_solve_seconds_total", "batch solve wall seconds"
+        )
+        self._latency = r.histogram(
+            "wavetpu_serve_request_seconds",
+            "end-to-end request latency", buckets=_LATENCY_BUCKETS,
+        )
+        self._queue_wait = r.histogram(
+            "wavetpu_serve_queue_wait_seconds",
+            "submit-to-batch-formed wait", buckets=_LATENCY_BUCKETS,
+        )
+        self._queue_depth = r.gauge(
+            "wavetpu_serve_queue_depth",
+            "requests submitted but not yet executing",
+        )
+        self._last_batch_ts = r.gauge(
+            "wavetpu_serve_last_batch_timestamp",
+            "unix time the last batch finished (0 = none yet)",
+        )
+        # Exact-percentile reservoir for the JSON snapshot's historical
+        # latency_p50/p95_ms fields (the histogram above serves
+        # Prometheus); guarded by the REGISTRY lock so snapshot() is one
+        # consistent cut.
         self._latencies = deque(maxlen=latency_window)
 
     def observe_request(self) -> None:
-        with self._lock:
-            self.requests_total += 1
+        self._requests.inc()
+
+    def observe_rejected(self) -> None:
+        self._rejected.inc()
 
     def observe_response(self, ok: bool) -> None:
-        with self._lock:
-            if ok:
-                self.responses_ok += 1
-            else:
-                self.responses_error += 1
+        self._responses.inc(status="ok" if ok else "error")
+
+    def observe_queue_depth(self, depth: int) -> None:
+        self._queue_depth.set(depth)
 
     def observe_batch(self, occupancy: int, batched: bool,
-                      cells: float, solve_seconds: float) -> None:
-        with self._lock:
-            self.batches_total += 1
-            self.occupancy_sum += occupancy
-            self.occupancy_max = max(self.occupancy_max, occupancy)
+                      cells: float, solve_seconds: float,
+                      batch_size: Optional[int] = None,
+                      queue_waits: Sequence[float] = ()) -> None:
+        with self.registry.lock:
+            self._batches.inc()
+            self._occupancy.observe(occupancy)
+            if occupancy > self._occupancy_max.value():
+                self._occupancy_max.set(occupancy)
+            if batch_size is not None and batch_size > occupancy:
+                self._padding.inc(batch_size - occupancy)
             if not batched:
-                self.fallback_batches += 1
-            self.cells_total += cells
-            self.solve_seconds_total += solve_seconds
+                self._fallbacks.inc()
+            self._cells.inc(cells)
+            self._solve_seconds.inc(solve_seconds)
+            self._last_batch_ts.set(time.time())
+            for w in queue_waits:
+                self._queue_wait.observe(w)
 
     def observe_latency(self, seconds: float) -> None:
-        with self._lock:
+        with self.registry.lock:
             self._latencies.append(seconds)
+            self._latency.observe(seconds)
 
     def _percentile(self, p: float) -> Optional[float]:
         if not self._latencies:
             return None
-        xs = sorted(self._latencies)
-        idx = min(len(xs) - 1, int(round(p * (len(xs) - 1))))
-        return xs[idx]
+        return percentile_nearest_rank(sorted(self._latencies), p)
+
+    def last_batch_age(self) -> Optional[float]:
+        """Seconds since the last batch finished, or None before any
+        batch - the load balancer's idle-vs-wedged discriminator."""
+        ts = self._last_batch_ts.value()
+        return None if ts == 0 else max(0.0, time.time() - ts)
 
     def snapshot(self) -> dict:
-        with self._lock:
-            mean_occ = (
-                self.occupancy_sum / self.batches_total
-                if self.batches_total else None
-            )
+        with self.registry.lock:
+            batches = int(self._batches.value())
+            occ = self._occupancy._snapshot_value()
+            mean_occ = occ["sum"] / batches if batches else None
             p50 = self._percentile(0.50)
             p95 = self._percentile(0.95)
+            solve_s = self._solve_seconds.value()
             agg = (
-                self.cells_total / self.solve_seconds_total / 1e9
-                if self.solve_seconds_total else None
+                self._cells.value() / solve_s / 1e9 if solve_s else None
             )
+            age = self.last_batch_age()
             return {
                 "uptime_seconds": round(time.time() - self.started, 3),
-                "requests_total": self.requests_total,
-                "responses_ok": self.responses_ok,
-                "responses_error": self.responses_error,
-                "batches_total": self.batches_total,
+                "requests_total": int(self._requests.value()),
+                "responses_ok": int(self._responses.value(status="ok")),
+                "responses_error": int(
+                    self._responses.value(status="error")
+                ),
+                "batches_total": batches,
                 "batch_occupancy_mean": mean_occ,
-                "batch_occupancy_max": self.occupancy_max,
-                "fallback_batches": self.fallback_batches,
+                "batch_occupancy_max": int(self._occupancy_max.value()),
+                "fallback_batches": int(self._fallbacks.value()),
                 "latency_p50_ms": None if p50 is None else round(
                     p50 * 1e3, 3
                 ),
@@ -146,6 +246,12 @@ class ServeMetrics:
                 "aggregate_gcells_per_s": (
                     None if agg is None else round(agg, 4)
                 ),
+                "queue_depth": int(self._queue_depth.value()),
+                "rejected_total": int(self._rejected.value()),
+                "padding_lanes_total": int(self._padding.value()),
+                "last_batch_age_seconds": (
+                    None if age is None else round(age, 3)
+                ),
             }
 
 
@@ -154,6 +260,11 @@ class _Item:
     request: SolveRequest
     future: Future
     key: Tuple
+    # Telemetry: the trace id the HTTP layer minted for this request
+    # (None untraced) and the monotonic submit time for queue-wait
+    # attribution.
+    request_id: Optional[str] = None
+    enqueued: float = 0.0
 
 
 class DynamicBatcher:
@@ -186,7 +297,8 @@ class DynamicBatcher:
 
     def __init__(self, engine, metrics: Optional[ServeMetrics] = None,
                  max_batch: Optional[int] = None, max_wait: float = 0.025,
-                 length_bucket_steps: Optional[int] = None):
+                 length_bucket_steps: Optional[int] = None,
+                 max_queue: Optional[int] = None):
         self.engine = engine
         self.metrics = metrics if metrics is not None else ServeMetrics()
         self.max_batch = (
@@ -200,8 +312,15 @@ class DynamicBatcher:
                 f"length_bucket_steps must be >= 1, got "
                 f"{length_bucket_steps}"
             )
+        if max_queue is not None and max_queue < 0:
+            raise ValueError(f"max_queue must be >= 0, got {max_queue}")
         self.max_wait = max_wait
         self.length_bucket_steps = length_bucket_steps
+        # Bounded-queue backpressure: submit() raises QueueFullError
+        # (HTTP 429) once this many requests are submitted-but-not-yet-
+        # executing.  None = unbounded (the historical behavior).
+        self.max_queue = max_queue
+        self._depth = 0
         self._q: "queue.Queue[_Item]" = queue.Queue()
         self._pending: "deque[_Item]" = deque()
         # Guards _pending: the worker mutates it between batches and
@@ -232,10 +351,31 @@ class DynamicBatcher:
     def _item_key(self, request: SolveRequest) -> Tuple:
         return request.bucket_key() + (self.length_bucket(request),)
 
-    def submit(self, request: SolveRequest) -> Future:
+    def _dec_depth(self, n: int) -> None:
+        # Gauge set INSIDE _plock: a set outside could interleave with a
+        # concurrent submit and leave a stale depth on an idle server.
+        # (Lock order is always _plock -> registry lock, never reversed.)
+        with self._plock:
+            self._depth = max(0, self._depth - n)
+            self.metrics.observe_queue_depth(self._depth)
+
+    def submit(self, request: SolveRequest,
+               request_id: Optional[str] = None) -> Future:
         if self._closed:
             raise RuntimeError("batcher is closed")
-        item = _Item(request, Future(), self._item_key(request))
+        with self._plock:
+            if self.max_queue is not None and self._depth >= self.max_queue:
+                self.metrics.observe_rejected()
+                raise QueueFullError(
+                    f"request queue full ({self._depth} waiting >= "
+                    f"max_queue {self.max_queue}); retry later"
+                )
+            self._depth += 1
+            self.metrics.observe_queue_depth(self._depth)
+        item = _Item(
+            request, Future(), self._item_key(request),
+            request_id=request_id, enqueued=time.monotonic(),
+        )
         self.metrics.observe_request()
         self._q.put(item)
         return item.future
@@ -272,6 +412,7 @@ class DynamicBatcher:
                 break
             if item is not None:
                 leftovers.append(item)
+        self._dec_depth(len(leftovers))
         for item in leftovers:
             if not item.future.done():
                 item.future.set_exception(
@@ -354,6 +495,18 @@ class DynamicBatcher:
 
     def _execute(self, batch: List[_Item]) -> None:
         req0 = batch[0].request
+        # Batch formed: the members' queue wait ends here; they leave
+        # the bounded queue's accounting as they enter the engine.
+        t_formed = time.monotonic()
+        waits = [max(0.0, t_formed - item.enqueued) for item in batch]
+        self._dec_depth(len(batch))
+        span = tracing.begin_span(
+            "serve.batch",
+            request_ids=[i.request_id for i in batch if i.request_id],
+            occupancy=len(batch), scheme=req0.scheme, path=req0.path,
+            k=req0.k, n=req0.problem.N,
+            queue_wait_max_ms=round(max(waits) * 1e3, 3),
+        )
         try:
             result, lane_health = self.engine.solve(
                 req0.problem,
@@ -362,10 +515,16 @@ class DynamicBatcher:
                 dtype_name=req0.dtype_name, mesh=req0.mesh_shape,
             )
         except Exception as e:
+            tracing.end_span(span, error=str(e))
             for item in batch:
                 if not item.future.done():
                     item.future.set_exception(e)
             return
+        tracing.end_span(
+            span, batch_size=result.batch_size, batched=result.batched,
+            padding_lanes=result.batch_size - result.n_lanes,
+            solve_seconds=round(result.solve_seconds, 6),
+        )
         cells = sum(
             req0.problem.cells_per_step * (r.steps_computed or 0)
             for r in result.results
@@ -373,6 +532,7 @@ class DynamicBatcher:
         self.metrics.observe_batch(
             occupancy=result.n_lanes, batched=result.batched,
             cells=cells, solve_seconds=result.solve_seconds,
+            batch_size=result.batch_size, queue_waits=waits,
         )
         batch_info = {
             "occupancy": result.n_lanes,
@@ -380,6 +540,7 @@ class DynamicBatcher:
             "batched": result.batched,
             "fallback_reason": result.fallback_reason,
             "path": result.path,
+            "padding_lanes": result.batch_size - result.n_lanes,
             "aggregate_gcells_per_s": round(
                 result.aggregate_gcells_per_second, 4
             ),

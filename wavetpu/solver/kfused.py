@@ -49,6 +49,7 @@ from jax import lax
 
 from wavetpu.core.problem import Problem
 from wavetpu.kernels import stencil_pallas, stencil_ref
+from wavetpu.obs import metrics as obs_metrics
 from wavetpu.solver import leapfrog
 from wavetpu.verify import oracle
 
@@ -309,7 +310,7 @@ def solve_kfused(
             runner, run_params, sync=lambda out: np.asarray(out[2])
         )
     )
-    return leapfrog.SolveResult(
+    result = leapfrog.SolveResult(
         problem=problem,
         u_prev=u_prev,
         u_cur=u_cur,
@@ -320,6 +321,8 @@ def solve_kfused(
         steps_computed=stop_step,
         final_step=stop_step if stop_step is not None else problem.timesteps,
     )
+    obs_metrics.record_solve(result, "kfused")
+    return result
 
 
 def resume_kfused(

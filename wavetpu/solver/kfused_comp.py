@@ -59,6 +59,7 @@ from jax import lax
 from wavetpu.core.problem import Problem
 from wavetpu import compat
 from wavetpu.kernels import stencil_pallas, stencil_ref
+from wavetpu.obs import metrics as obs_metrics
 from wavetpu.solver import kfused, leapfrog
 from wavetpu.verify import oracle
 
@@ -419,10 +420,12 @@ def solve_kfused_comp(
     out, init_s, solve_s = leapfrog._timed_compile_run(
         runner, run_params, sync=lambda o: np.asarray(o[3])
     )
-    return _as_result(
+    result = _as_result(
         problem, out, init_s, solve_s, stop_step,
         stop_step if stop_step is not None else problem.timesteps,
     )
+    obs_metrics.record_solve(result, "kfused_comp")
+    return result
 
 
 def _validate_sharded(problem: Problem, dtype, v_dtype, carry, k, n_x,
@@ -818,10 +821,12 @@ def solve_kfused_comp_sharded(
     out, init_s, solve_s = leapfrog._timed_compile_run(
         runner, run_params, sync=lambda o: np.asarray(o[3])
     )
-    return _as_result(
+    result = _as_result(
         problem, out, init_s, solve_s, stop_step,
         stop_step if stop_step is not None else problem.timesteps,
     )
+    obs_metrics.record_solve(result, "kfused_comp_sharded")
+    return result
 
 
 def resume_kfused_comp_sharded(

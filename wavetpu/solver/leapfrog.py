@@ -30,6 +30,7 @@ import numpy as np
 
 from wavetpu.core.problem import Problem
 from wavetpu.kernels import stencil_ref
+from wavetpu.obs import metrics as obs_metrics
 from wavetpu.verify import oracle
 
 
@@ -384,7 +385,7 @@ def solve(
     (u_prev, u_cur, abs_all, rel_all), init_s, solve_s = _timed_compile_run(
         runner, (step_params,), sync=lambda out: np.asarray(out[2])
     )
-    return SolveResult(
+    result = SolveResult(
         problem=problem,
         u_prev=u_prev,
         u_cur=u_cur,
@@ -395,6 +396,8 @@ def solve(
         steps_computed=stop_step,
         final_step=stop_step if stop_step is not None else problem.timesteps,
     )
+    obs_metrics.record_solve(result, "leapfrog")
+    return result
 
 
 def make_compensated_solver(
@@ -497,7 +500,7 @@ def solve_compensated(
     (u_prev, u_cur, v, carry, abs_all, rel_all), init_s, solve_s = (
         _timed_compile_run(runner, (), sync=lambda out: np.asarray(out[4]))
     )
-    return SolveResult(
+    result = SolveResult(
         problem=problem,
         u_prev=u_prev,
         u_cur=u_cur,
@@ -510,6 +513,8 @@ def solve_compensated(
         comp_v=v,
         comp_carry=carry,
     )
+    obs_metrics.record_solve(result, "compensated")
+    return result
 
 
 def resume_compensated(

@@ -50,6 +50,7 @@ from wavetpu.core.grid import AXIS_NAMES, Topology, build_mesh, choose_mesh_shap
 from wavetpu.core.problem import Problem
 from wavetpu import compat
 from wavetpu.kernels import stencil_pallas, stencil_ref
+from wavetpu.obs import metrics as obs_metrics
 from wavetpu.solver.leapfrog import SolveResult
 from wavetpu.verify import oracle
 
@@ -832,7 +833,7 @@ def solve_sharded(
         f = stencil_ref.compute_dtype(dtype)
         rt_args = (jnp.asarray(pad_field(c2tau2_field, topo), dtype=f),)
     out, abs_np, rel_np, init_s, solve_s = _run_timed(runner, rt_args)
-    return SolveResult(
+    result = SolveResult(
         problem=problem,
         u_prev=out[0],
         u_cur=out[1],
@@ -845,6 +846,8 @@ def solve_sharded(
         comp_v=out[4] if scheme == "compensated" else None,
         comp_carry=out[5] if scheme == "compensated" else None,
     )
+    obs_metrics.record_solve(result, "sharded")
+    return result
 
 
 def resume_sharded(

@@ -50,6 +50,7 @@ from wavetpu.core.grid import build_mesh
 from wavetpu.core.problem import Problem
 from wavetpu import compat
 from wavetpu.kernels import stencil_pallas, stencil_ref
+from wavetpu.obs import metrics as obs_metrics
 from wavetpu.solver import kfused, leapfrog
 from wavetpu.solver.leapfrog import SolveResult
 
@@ -829,7 +830,7 @@ def solve_sharded_kfused(
     if sliced:
         u_prev = _to_topology_layout(u_prev, problem, mesh, n_x)
         u_cur = _to_topology_layout(u_cur, problem, mesh, n_x)
-    return SolveResult(
+    result = SolveResult(
         problem=problem,
         u_prev=u_prev,
         u_cur=u_cur,
@@ -840,6 +841,8 @@ def solve_sharded_kfused(
         steps_computed=stop_step,
         final_step=stop_step if stop_step is not None else problem.timesteps,
     )
+    obs_metrics.record_solve(result, "sharded_kfused")
+    return result
 
 
 def resume_sharded_kfused(
