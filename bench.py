@@ -341,6 +341,109 @@ def _ensemble_rows(interp, scheme="standard", path="pallas", k=1,
     return rows
 
 
+def _loadgen_row(interp):
+    """Traffic realism measured: a mixed-scenario trace replayed twice
+    through the FULL HTTP serving stack (`wavetpu loadgen` against an
+    in-process `wavetpu serve`), with the second replay regression-
+    gated against the first (self-consistency - the same gate CI runs
+    between commits must pass between back-to-back replays of one
+    warmed server).
+
+    Also measures the request-path OBSERVER overhead: the same trace
+    replayed against a twin server built with `--no-server-timing`
+    (header assembly + latency-exemplar plumbing off).  The bar is
+    <= 2% - same budget as PR 5's telemetry row - because the observer
+    is host-side string/dict work per request, never device work.
+    Backend-adaptive scale like the ensemble rows: the chip serves the
+    production-ish N=64/20 pallas shape, interpret/CPU mode the
+    dispatch-dominated N=8/6 roll shape."""
+    import threading
+    import traceback
+
+    from wavetpu.loadgen import report as lg_report
+    from wavetpu.loadgen import runner, trace
+    from wavetpu.serve.api import build_server
+
+    n, steps, kernel = (8, 6, "roll") if interp else (64, 20, "auto")
+    scenarios = trace.default_scenarios(n=n, timesteps=steps)
+    records = trace.generate(
+        "poisson", duration=3.0, qps=6.0, scenarios=scenarios, seed=11
+    )
+
+    def serve(server_timing=True):
+        httpd, state = build_server(
+            port=0, max_wait=0.02, default_kernel=kernel,
+            interpret=interp, server_timing=server_timing,
+        )
+        th = threading.Thread(target=httpd.serve_forever, daemon=True)
+        th.start()
+        return httpd, state, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    def run(base, warmup):
+        res = runner.replay(base, records, mode="closed",
+                            concurrency=4, warmup=warmup, timeout=1800)
+        return lg_report.build_report(res, target=base)
+
+    try:
+        httpd, state, base = serve()
+        try:
+            run(base, warmup=len(scenarios))  # warm every tier + bucket
+            rep1 = run(base, warmup=0)
+            rep2 = run(base, warmup=0)
+        finally:
+            httpd.shutdown()
+            state.batcher.close()
+            httpd.server_close()
+        violations = lg_report.gate(
+            rep2, baseline=rep1,
+            slo={"p99_regression_pct": 100.0,
+                 "throughput_floor_pct": 60.0},
+        )
+        # Observer A/B: identical replay, Server-Timing assembly off.
+        httpd, state, base = serve(server_timing=False)
+        try:
+            run(base, warmup=len(scenarios))
+            rep_off = run(base, warmup=0)
+        finally:
+            httpd.shutdown()
+            state.batcher.close()
+            httpd.server_close()
+        p50_on = rep2["latency_ms"]["p50_ms"]
+        p50_off = rep_off["latency_ms"]["p50_ms"]
+        return {
+            "requests": rep2["requests"],
+            "tiers": len(rep2["tiers"]),
+            "p50_ms": p50_on,
+            "p99_ms": rep2["latency_ms"]["p99_ms"],
+            "occupancy_mean": rep2["server"]["occupancy_mean"],
+            "reject_rate": rep2["reject_rate"],
+            "error_rate": rep2["error_rate"],
+            "aggregate_gcells_per_s":
+                rep2["server"]["aggregate_gcells_per_s"],
+            "server_timing_mean_ms": rep2["server_timing_mean_ms"],
+            "cold_compiles": rep2["server"]["cold_compiles"],
+            "gate": "pass" if not violations else violations,
+            "self_p99_delta_pct": round(
+                100.0 * (rep2["latency_ms"]["p99_ms"]
+                         / rep1["latency_ms"]["p99_ms"] - 1.0), 2
+            ) if rep1["latency_ms"]["p99_ms"] else None,
+            "observer_overhead_pct_vs_no_server_timing": round(
+                100.0 * (p50_on - p50_off) / p50_off, 2
+            ) if p50_off else None,
+            "policy": "best_of_1",
+            "config": (
+                f"poisson mix {len(records)} reqs x2 replays, closed "
+                f"loop c=4, N={n}/{steps} kernel={kernel}, warmed; "
+                f"gate = replay2 vs replay1 (p99 +100%/throughput "
+                f"-60%); observer A/B vs --no-server-timing, bar <= 2%"
+            ),
+        }
+    except Exception:
+        print("loadgen sub-benchmark failed:", file=sys.stderr)
+        traceback.print_exc()
+        return {"error": "failed; see stderr"}
+
+
 def _occupancy_sweep(interp):
     """Batch-occupancy vs max_wait: the tail-latency/occupancy knob
     measured.  8 requests arrive ~10 ms apart at a max_batch=8 batcher;
@@ -695,6 +798,10 @@ def main() -> int:
         )
     # Occupancy/latency knob measured: batch occupancy vs max_wait.
     subs["ensemble_occupancy"] = _occupancy_sweep(interp)
+    # Traffic realism: mixed-scenario trace replayed through the full
+    # HTTP stack, self-consistency regression gate, and the request-
+    # path observer (Server-Timing + exemplars) overhead A/B.
+    subs["loadgen"] = _loadgen_row(interp)
     line = {
         "metric": "gcell_updates_per_s",
         "value": head["gcells_per_s"],
@@ -759,6 +866,11 @@ def main() -> int:
         "occupancy_mean_at_250ms_wait": subs["ensemble_occupancy"].get(
             "max_wait_250ms", {}
         ).get("occupancy_mean"),
+        "loadgen_p99_ms": subs["loadgen"].get("p99_ms"),
+        "loadgen_occupancy_mean": subs["loadgen"].get("occupancy_mean"),
+        "loadgen_observer_overhead_pct": subs["loadgen"].get(
+            "observer_overhead_pct_vs_no_server_timing"
+        ),
         "headline_summary": True,
     }
     print(json.dumps(summary))

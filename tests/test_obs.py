@@ -19,15 +19,21 @@ from wavetpu.obs import telemetry, tracing
 from wavetpu.obs.registry import MetricsRegistry, get_registry
 
 
-def parse_prometheus(text):
+def parse_prometheus(text, with_exemplars=False):
     """Minimal exposition-format parser: {sample_name_with_labels: float}
     plus {family: type}.  Raises on malformed lines, so using it IS the
-    validity assertion."""
-    samples, types = {}, {}
+    validity assertion.  `with_exemplars=True` additionally validates +
+    returns the OpenMetrics exemplar suffixes (`name # {labels} value
+    ts`) and the trailing `# EOF` marker as a third mapping
+    {sample_name: {"labels": {...}, "value": float, "ts": float}}."""
+    samples, types, exemplars = {}, {}, {}
     for line in text.splitlines():
         if not line:
             continue
         if line.startswith("# HELP "):
+            continue
+        if line == "# EOF":
+            assert with_exemplars, "EOF marker outside openmetrics mode"
             continue
         if line.startswith("# TYPE "):
             _, _, family, kind = line.split(" ", 3)
@@ -35,9 +41,28 @@ def parse_prometheus(text):
             types[family] = kind
             continue
         assert not line.startswith("#"), f"unknown comment {line!r}"
+        if " # " in line:
+            assert with_exemplars, f"exemplar in plain exposition: {line!r}"
+            line, ex = line.split(" # ", 1)
+            assert ex.startswith("{"), f"malformed exemplar {ex!r}"
+            labelpart, _, rest = ex[1:].partition("} ")
+            ev, _, ets = rest.partition(" ")
+            ex_labels = {}
+            if labelpart:
+                for pair in labelpart.split('",'):
+                    k, _, v = pair.partition('="')
+                    ex_labels[k] = v.rstrip('"')
+            name_for_ex = line.rpartition(" ")[0]
+            exemplars[name_for_ex] = {
+                "labels": ex_labels,
+                "value": float(ev),
+                "ts": float(ets),
+            }
         name, _, value = line.rpartition(" ")
         assert name, f"malformed sample line {line!r}"
         samples[name] = float(value.replace("+Inf", "inf"))
+    if with_exemplars:
+        return samples, types, exemplars
     return samples, types
 
 
@@ -107,6 +132,63 @@ class TestRegistry:
         samples, _ = parse_prometheus(r.render_prometheus())
         assert snap["wavetpu_a_total"] == samples["wavetpu_a_total"] == 4
         assert snap["wavetpu_b"] == samples["wavetpu_b"] == 2.5
+
+    def test_histogram_exemplars_openmetrics_only(self):
+        """Exemplars pin a request id to the bucket an observation
+        landed in, render ONLY in the openmetrics view (`# {labels} v
+        ts` + `# EOF`), and the classic 0.0.4 text stays byte-stable
+        for parsers that do not speak the suffix."""
+        r = MetricsRegistry()
+        h = r.histogram("wavetpu_ex_seconds", "lat", buckets=(0.1, 1.0))
+        h.observe(0.05, exemplar={"request_id": "lg-1"})
+        h.observe(0.5)  # no exemplar for this bucket
+        h.observe(7.0, exemplar={"request_id": "lg-3"})
+        plain = r.render_prometheus()
+        assert " # " not in plain and "# EOF" not in plain
+        parse_prometheus(plain)  # still valid 0.0.4
+        om = r.render_prometheus(openmetrics=True)
+        samples, types, exemplars = parse_prometheus(
+            om, with_exemplars=True
+        )
+        assert om.rstrip().endswith("# EOF")
+        assert types["wavetpu_ex_seconds"] == "histogram"
+        # the 0.05 observation landed in the le=0.1 bucket...
+        ex = exemplars['wavetpu_ex_seconds_bucket{le="0.1"}']
+        assert ex["labels"] == {"request_id": "lg-1"}
+        assert ex["value"] == pytest.approx(0.05)
+        assert ex["ts"] > 0
+        # ...the 7.0 one overflowed to +Inf...
+        assert exemplars['wavetpu_ex_seconds_bucket{le="+Inf"}'][
+            "labels"
+        ] == {"request_id": "lg-3"}
+        # ...and the exemplar-less bucket has none.
+        assert 'wavetpu_ex_seconds_bucket{le="1"}' not in exemplars
+        # counts are untouched by exemplar bookkeeping
+        assert samples["wavetpu_ex_seconds_count"] == 3
+
+    def test_openmetrics_counter_family_drops_total_suffix(self):
+        """OpenMetrics names a counter FAMILY without the _total suffix
+        (samples keep it); the 0.0.4 view keeps the historical
+        full-name TYPE line so existing scrapes are untouched."""
+        r = MetricsRegistry()
+        r.counter("wavetpu_om_total", "c").inc()
+        om = r.render_prometheus(openmetrics=True)
+        assert "# TYPE wavetpu_om counter" in om
+        assert "\nwavetpu_om_total 1" in om
+        plain = r.render_prometheus()
+        assert "# TYPE wavetpu_om_total counter" in plain
+
+    def test_exemplar_latest_wins_per_bucket(self):
+        r = MetricsRegistry()
+        h = r.histogram("wavetpu_ex2_seconds", "lat", buckets=(1.0,))
+        h.observe(0.1, exemplar={"request_id": "a"})
+        h.observe(0.2, exemplar={"request_id": "b"})
+        _, _, exemplars = parse_prometheus(
+            r.render_prometheus(openmetrics=True), with_exemplars=True
+        )
+        assert exemplars['wavetpu_ex2_seconds_bucket{le="1"}'][
+            "labels"
+        ] == {"request_id": "b"}
 
     def test_snapshot_is_one_consistent_cut(self):
         # A writer bumps two counters under the registry lock; no
@@ -214,6 +296,79 @@ class TestTracing:
             tracing.disable()
         (rec,) = [json.loads(line) for line in open(path)]
         assert rec["attrs"] == {"a": 1, "ok": True}
+
+
+class TestTraceRotation:
+    """Size-based telemetry rotation: a long-lived server must not grow
+    trace.jsonl / heartbeat.jsonl forever (keep-last-K segments, atomic
+    os.replace shifts), and trace-report reads the whole rotated set."""
+
+    def test_tracer_rotates_and_keeps_k_segments(self, tmp_path):
+        path = str(tmp_path / "trace.jsonl")
+        # ~120 B records against a 400 B cap: every few events rotate.
+        tracing.configure(path, max_bytes=400, keep=3)
+        try:
+            for i in range(40):
+                tracing.event("rot.tick", n=i)
+        finally:
+            tracing.disable()
+        segs = [p.name for p in sorted(tmp_path.iterdir())]
+        assert "trace.jsonl" in segs
+        assert "trace.jsonl.1" in segs and "trace.jsonl.2" in segs
+        assert "trace.jsonl.3" not in segs  # keep=3 total segments
+        for p in tmp_path.iterdir():
+            assert p.stat().st_size <= 400 + 200  # cap + one record slack
+
+    def test_load_trace_reads_rotated_set_oldest_first(self, tmp_path):
+        path = str(tmp_path / "trace.jsonl")
+        tracing.configure(path, max_bytes=400, keep=4)
+        try:
+            for i in range(30):
+                tracing.event("rot.tick", n=i)
+        finally:
+            tracing.disable()
+        records = obs_report.load_trace(path)
+        ns = [r["attrs"]["n"] for r in records]
+        # the retained window is contiguous, ordered, and ends at the
+        # newest record; older-than-window records were GCed
+        assert ns == list(range(ns[0], 30))
+        # include_rotated=False reads only the live segment
+        live = obs_report.load_trace(path, include_rotated=False)
+        assert len(live) < len(records)
+        # segments enumerate oldest -> newest, live file last
+        segs = obs_report.trace_segments(path)
+        assert segs[-1] == path and len(segs) >= 2
+
+    def test_heartbeat_rotation(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.counter("wavetpu_beats_total", "x").inc()
+        tel = telemetry.start(str(tmp_path), registry=reg,
+                              interval=60.0, max_bytes=300, keep=2)
+        try:
+            for _ in range(20):
+                tel.beat()
+        finally:
+            tel.stop()
+        assert (tmp_path / "heartbeat.jsonl").exists()
+        assert (tmp_path / "heartbeat.jsonl.1").exists()
+        assert not (tmp_path / "heartbeat.jsonl.2").exists()
+        # every retained line is whole JSON (atomic rotation, no tears)
+        for name in ("heartbeat.jsonl", "heartbeat.jsonl.1"):
+            for line in open(tmp_path / name):
+                assert "metrics" in json.loads(line)
+
+    def test_rotation_disabled_by_default_for_direct_configure(
+        self, tmp_path
+    ):
+        path = str(tmp_path / "trace.jsonl")
+        tracing.configure(path)
+        try:
+            for i in range(50):
+                tracing.event("rot.tick", n=i)
+        finally:
+            tracing.disable()
+        assert not (tmp_path / "trace.jsonl.1").exists()
+        assert len(obs_report.load_trace(path)) == 50
 
 
 # ---- trace-report ----

@@ -205,9 +205,12 @@ class _FakeEngine:
         self.fail = fail
 
     def solve(self, problem, lanes, scheme, path, k, dtype_name,
-              mesh=None):
+              mesh=None, timing=None):
         if self.fail:
             raise RuntimeError("engine exploded")
+        if timing is not None:
+            timing["compile_seconds"] = 0.0
+            timing["warm"] = "true"
         self.batches.append(len(lanes))
         results = [
             types.SimpleNamespace(steps_computed=problem.timesteps)
@@ -529,6 +532,20 @@ class TestMetricsRegistryIntegration:
         assert snap["padding_lanes_total"] == 1
         assert snap["last_batch_age_seconds"] is not None
 
+    def test_last_batch_age_none_only_before_any_batch(self):
+        """The /healthz discriminator: age is None IFF no batch was
+        ever executed.  Keyed on the batches counter, not the timestamp
+        gauge, so a gauge sitting at its 0.0 default ("idle since t=0")
+        can never read as "never executed"."""
+        m = ServeMetrics()
+        assert m.last_batch_age() is None
+        m.observe_batch(occupancy=1, batched=True, cells=1.0,
+                        solve_seconds=0.1)
+        assert m.last_batch_age() is not None
+        # even a zero timestamp is "has executed", not "never"
+        m._last_batch_ts.set(0.0)
+        assert m.last_batch_age() is not None
+
     def test_json_and_text_views_agree(self):
         m = ServeMetrics()
         for _ in range(3):
@@ -656,15 +673,20 @@ def server():
 
 
 def _post(base, body, timeout=120):
+    code, payload, _headers = _post_full(base, body, timeout=timeout)
+    return code, payload
+
+
+def _post_full(base, body, timeout=120, headers=None):
     req = urllib.request.Request(
         base + "/solve", data=json.dumps(body).encode(),
-        headers={"Content-Type": "application/json"},
+        headers={"Content-Type": "application/json", **(headers or {})},
     )
     try:
         with urllib.request.urlopen(req, timeout=timeout) as r:
-            return r.status, json.loads(r.read())
+            return r.status, json.loads(r.read()), dict(r.headers)
     except urllib.error.HTTPError as e:
-        return e.code, json.loads(e.read())
+        return e.code, json.loads(e.read()), dict(e.headers)
 
 
 def _get(base, path):
@@ -790,6 +812,201 @@ class TestHTTP:
         view = obs_report.request_view(recs, rid)
         kinds = {r["kind"] for r in view}
         assert {"serve.request", "serve.batch", "serve.execute"} <= kinds
+
+    def test_server_timing_components_sum_to_total(self, server):
+        """Acceptance: every /solve response carries Server-Timing whose
+        additive components (queue + compile + execute) sum to within
+        10% of the server-measured wall (`total`), and the per-request
+        timing rides the JSON batch context too."""
+        from wavetpu.loadgen.runner import parse_server_timing
+
+        base, _ = server
+        for i in range(2):  # first contact (cold compile) AND warm
+            t0 = time.monotonic()
+            code, body, headers = _post_full(
+                base, {"N": 8, "timesteps": 4, "phase": 1.0 + i}
+            )
+            client_wall = time.monotonic() - t0
+            assert code == 200
+            timing = parse_server_timing(headers.get("Server-Timing"))
+            assert set(timing) == {
+                "queue", "compile", "execute", "padding", "total"
+            }
+            additive = timing["queue"] + timing["compile"] + \
+                timing["execute"]
+            # components ~= the server-measured wall (parse/serialize
+            # overhead is the slack; 10% + a tiny absolute epsilon for
+            # the CI-scale solves where total is single-digit ms)
+            assert abs(additive - timing["total"]) <= \
+                0.1 * timing["total"] + 0.010
+            # server total never exceeds what the client measured
+            assert timing["total"] <= client_wall + 0.010
+            # padding is a subset-of-execute attribution
+            assert timing["padding"] <= timing["execute"] + 1e-9
+            # and the same attribution is in the JSON batch context
+            jt = body["batch"]["timing"]
+            assert jt["compile_s"] == pytest.approx(
+                timing["compile"], abs=1e-4
+            )
+        # the cold/warm split is visible: first request compiled,
+        # second hit the cache
+        assert body["batch"]["warm"] == "true"
+
+    def test_request_id_echoed_and_client_id_wins(self, server):
+        base, _ = server
+        # client-minted id is echoed verbatim
+        code, _body, headers = _post_full(
+            base, {"N": 8, "timesteps": 4},
+            headers={"X-Request-Id": "lg-abc-7"},
+        )
+        assert code == 200
+        assert headers.get("X-Request-Id") == "lg-abc-7"
+        # junk ids (bad chars / over-long) are dropped, not reflected
+        junk = 'evil"id with spaces' + "x" * 80
+        code, _body, headers = _post_full(
+            base, {"N": 8, "timesteps": 4},
+            headers={"X-Request-Id": junk},
+        )
+        assert code == 200
+        assert headers.get("X-Request-Id") != junk
+
+    def test_client_request_id_tags_server_spans(self, server, tmp_path):
+        """The loadgen join contract: a client-supplied X-Request-Id is
+        THE request_id on the server's trace spans, so a report outlier
+        resolves via `wavetpu trace-report --request ID`."""
+        from wavetpu.obs import report as obs_report
+        from wavetpu.obs import tracing
+
+        base, _ = server
+        path = str(tmp_path / "trace.jsonl")
+        tracing.configure(path)
+        try:
+            code, _, headers = _post_full(
+                base, {"N": 8, "timesteps": 4},
+                headers={"X-Request-Id": "lg-join-1"},
+            )
+            assert code == 200
+        finally:
+            tracing.disable()
+        recs = [json.loads(line) for line in open(path)]
+        view = obs_report.request_view(recs, "lg-join-1")
+        kinds = {r["kind"] for r in view}
+        assert {"serve.request", "serve.batch", "serve.execute"} <= kinds
+
+    def test_metrics_openmetrics_exemplars_negotiated(self, server):
+        base, _ = server
+        _post_full(base, {"N": 8, "timesteps": 4},
+                   headers={"X-Request-Id": "lg-ex-1"})
+        req = urllib.request.Request(
+            base + "/metrics",
+            headers={"Accept": "application/openmetrics-text"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith(
+                "application/openmetrics-text"
+            )
+            text = r.read().decode()
+        samples, _types, exemplars = parse_prometheus(
+            text, with_exemplars=True
+        )
+        assert text.rstrip().endswith("# EOF")
+        # the latency histogram carries the request id as an exemplar
+        latency_ex = [
+            ex for name, ex in exemplars.items()
+            if name.startswith("wavetpu_serve_request_seconds_bucket")
+        ]
+        assert any(
+            ex["labels"].get("request_id") == "lg-ex-1"
+            for ex in latency_ex
+        )
+        # plain text/plain stays exemplar-free (0.0.4 parsers)
+        req = urllib.request.Request(
+            base + "/metrics", headers={"Accept": "text/plain"}
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            plain = r.read().decode()
+        assert " # " not in plain and "# EOF" not in plain
+
+    def test_malformed_content_length_gets_400(self, server):
+        """A junk Content-Length header must produce a 400 JSON error,
+        not an unhandled handler exception (dropped connection)."""
+        import socket
+
+        base, _ = server
+        host, port = base.replace("http://", "").split(":")
+        with socket.create_connection((host, int(port)), timeout=30) as s:
+            s.sendall(
+                b"POST /solve HTTP/1.1\r\nHost: x\r\n"
+                b"Content-Length: abc\r\n\r\n"
+            )
+            data = s.recv(65536)
+        status_line = data.split(b"\r\n", 1)[0]
+        assert b" 400 " in status_line + b" "
+        assert b"Content-Length" in data
+        # A NEGATIVE length must 400 too - rfile.read(-1) would block
+        # to EOF and pin the handler thread forever (thread-exhaustion
+        # DoS), so it is the same malformed-header case.
+        with socket.create_connection((host, int(port)), timeout=30) as s:
+            s.sendall(
+                b"POST /solve HTTP/1.1\r\nHost: x\r\n"
+                b"Content-Length: -1\r\n\r\n"
+            )
+            data = s.recv(65536)
+        assert b" 400 " in data.split(b"\r\n", 1)[0] + b" "
+
+    def test_max_body_bytes_413(self):
+        httpd, state = build_server(
+            port=0, max_wait=0.1, default_kernel="roll",
+            interpret=True, max_body_bytes=64,
+        )
+        thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+        thread.start()
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            big = {"N": 8, "timesteps": 4, "pad": "x" * 500}
+            code, body, _ = _post_full(base, big)
+            assert code == 413
+            assert "max-body-bytes" in body["error"]
+            code, snap = _get(base, "/metrics")
+            assert snap["limit_rejected_total"] == 1
+            # and in the Prometheus view, labeled by limit
+            samples, _ = parse_prometheus(
+                state.metrics.registry.render_prometheus()
+            )
+            assert samples[
+                'wavetpu_serve_limit_rejected_total{limit="body_bytes"}'
+            ] == 1
+            # a small request still serves
+            code, _, _ = _post_full(base, {"N": 8, "timesteps": 4})
+            assert code == 200
+        finally:
+            httpd.shutdown()
+            state.batcher.close()
+            httpd.server_close()
+
+    def test_max_lane_cells_422_before_scheduling(self):
+        httpd, state = build_server(
+            port=0, max_wait=0.1, default_kernel="roll",
+            interpret=True, max_lane_cells=1000,  # (N+1)^3 <= 1000
+        )
+        thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+        thread.start()
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            code, body, _ = _post_full(base, {"N": 16, "timesteps": 4})
+            assert code == 422
+            assert "max-lane-cells" in body["error"]
+            code, snap = _get(base, "/metrics")
+            assert snap["limit_rejected_total"] == 1
+            # nothing reached the scheduler
+            assert snap["batches_total"] == 0
+            code, _, _ = _post_full(base, {"N": 8, "timesteps": 4})
+            assert code == 200  # 9^3 = 729 <= 1000
+        finally:
+            httpd.shutdown()
+            state.batcher.close()
+            httpd.server_close()
 
     def test_queue_full_returns_429(self):
         httpd, state = build_server(

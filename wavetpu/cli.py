@@ -162,8 +162,14 @@ front end (wavetpu/serve/api.py, also installed as `wavetpu-serve`;
 endpoint contract in docs/serving.md).  `wavetpu trace-report
 TRACE.jsonl [--kind K] [--request ID]` summarizes a --telemetry-dir
 span trace (per-kind count/total/p50/p95; critical-path view of one
-request - wavetpu/obs/report.py).  `wavetpu --version` prints the
-package version (both entry points accept it).
+request - wavetpu/obs/report.py; rotated segment sets are read whole).
+`wavetpu loadgen generate|replay|gate` is the traffic-realism harness
+(wavetpu/loadgen/, docs/observability.md): generate or record mixed-
+scenario JSONL traces, replay them open-/closed-loop against a live
+`wavetpu serve`, emit loadgen_report.json with per-tier p50/p95/p99 +
+occupancy + Server-Timing attribution, and diff two reports as a
+perf-regression gate (exit 1 on SLO violation).  `wavetpu --version`
+prints the package version (both entry points accept it).
 """
 
 from __future__ import annotations
@@ -203,31 +209,11 @@ def resolve_kernel(flag_value: str, platform: str) -> str:
 
 
 def _split_flags(argv: Sequence[str]) -> Tuple[List[str], dict]:
-    """Separate reference-style positionals from --flag[=value] options.
+    """Separate reference-style positionals from --flag[=value] options
+    (the shared core.flags parser bound to this CLI's flag table)."""
+    from wavetpu.core.flags import split_flags
 
-    Raises ValueError for unknown flags or a flag missing its value, so typos
-    surface as the usage error instead of being silently ignored.
-    """
-    pos, flags = [], {}
-    it = iter(argv)
-    for a in it:
-        if a.startswith("--"):
-            if "=" in a:
-                k, v = a[2:].split("=", 1)
-            else:
-                k = a[2:]
-                if k in _VALUELESS:
-                    v = ""
-                else:
-                    v = next(it, None)
-                    if v is None:
-                        raise ValueError(f"flag --{k} needs a value")
-            if k not in _KNOWN_FLAGS:
-                raise ValueError(f"unknown flag --{k}")
-            flags[k] = v
-        else:
-            pos.append(a)
-    return pos, flags
+    return split_flags(argv, _KNOWN_FLAGS, _VALUELESS)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -243,6 +229,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from wavetpu.obs import report as obs_report
 
         return obs_report.main(argv[1:])
+    if argv and argv[0] == "loadgen":
+        # Trace-replay load generator + SLO regression gate (stdlib
+        # HTTP client; never touches jax - runnable off-accelerator).
+        from wavetpu.loadgen import cli as loadgen_cli
+
+        return loadgen_cli.main(argv[1:])
     if "--version" in argv:
         from wavetpu import __version__
 
@@ -366,6 +358,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(
             "usage: wavetpu N Np Lx Ly Lz [T] [timesteps] | "
             "wavetpu serve [...] | wavetpu trace-report TRACE.jsonl | "
+            "wavetpu loadgen generate|replay|gate [...] | "
             "wavetpu --version\n"
             "       wavetpu N Np Lx Ly Lz [T] [timesteps] "
             "[--backend auto|single|sharded] [--mesh MX,MY,MZ] "

@@ -1,0 +1,50 @@
+"""One `--flag[=value]` argv parser for every wavetpu CLI surface.
+
+The solver CLI, `wavetpu serve`, and `wavetpu loadgen` all speak the
+same flag dialect (`--flag value`, `--flag=value`, valueless switches,
+reference-style positionals); this is the single implementation so
+error wording and edge cases (`--flag` at end of argv, unknown flags as
+loud usage errors instead of silent drops) cannot drift between them.
+
+Imports nothing (same before-the-backend discipline as core.problem).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+
+def split_flags(
+    argv: Sequence[str],
+    known: Sequence[str],
+    valueless: Sequence[str] = (),
+    allow_positionals: bool = True,
+) -> Tuple[List[str], Dict[str, str]]:
+    """Separate positionals from --flag[=value] options.
+
+    Raises ValueError for unknown flags, a flag missing its value, or
+    (with `allow_positionals=False`) any positional - so typos surface
+    as the caller's usage error instead of being silently ignored."""
+    pos: List[str] = []
+    flags: Dict[str, str] = {}
+    it = iter(argv)
+    for a in it:
+        if a.startswith("--"):
+            if "=" in a:
+                k, v = a[2:].split("=", 1)
+            else:
+                k = a[2:]
+                if k in valueless:
+                    v = ""
+                else:
+                    v = next(it, None)
+                    if v is None:
+                        raise ValueError(f"flag --{k} needs a value")
+            if k not in known:
+                raise ValueError(f"unknown flag --{k}")
+            flags[k] = v
+        else:
+            if not allow_positionals:
+                raise ValueError(f"unexpected positional {a!r}")
+            pos.append(a)
+    return pos, flags

@@ -1,0 +1,26 @@
+"""Workload observability: scenario traces, replay harness, SLO gate.
+
+`wavetpu loadgen` closes the last observability gap: PR 5 made ONE
+request's latency attributable (queue vs compile vs execute vs padding
+spans); this package makes the service observable under realistic MIXED
+traffic - the sustained-workload methodology the scale-out papers in
+PAPERS.md report by (arXiv:2506.09242 multi-GPU PALABOS,
+arXiv:2108.11076 TPU-pod), and the measurement harness every ROADMAP
+direction (pod-scale serving, cold-start elimination, comm overlap,
+autotuned tiers) must be judged against: tail latency under load, not
+solo-solve Gcell/s.
+
+    trace.py   JSONL scenario-trace format, synthetic generators
+               (uniform / poisson / diurnal / hotkey), and the recorder
+               `wavetpu serve --record-trace` uses to capture real
+               /solve traffic into replayable traces
+    runner.py  open-/closed-loop replay against a live server: preflight
+               health check, warmup phase, per-request Server-Timing
+               capture, /metrics scrapes bracketing the run
+    report.py  loadgen_report.json builder + the regression gate
+               (`--baseline OLD.json` diffs, exit != 0 on SLO violation)
+    cli.py     `wavetpu loadgen generate | replay | gate`
+
+Pure stdlib HTTP client + host-side math; never imports jax - the load
+generator must be runnable from a machine that has no accelerator.
+"""

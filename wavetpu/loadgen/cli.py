@@ -1,0 +1,201 @@
+"""`wavetpu loadgen` - generate, replay, gate.
+
+    wavetpu loadgen generate --out TRACE.jsonl [--mix poisson]
+        [--duration S] [--qps Q] [--seed N] [--n N] [--timesteps T]
+        [--pallas] [--distinct D]
+    wavetpu loadgen replay TRACE.jsonl --target URL [--mode open|closed]
+        [--concurrency C] [--speed X] [--warmup W] [--timeout S]
+        [--out REPORT.json] [--no-preflight]
+        [--baseline OLD.json] [SLO flags]
+    wavetpu loadgen gate REPORT.json --baseline OLD.json [SLO flags]
+
+SLO flags (gate + replay-with-baseline):
+    --p99-budget-ms X          absolute p99 cap
+    --error-budget F           allowed non-ok non-429 fraction (default 0)
+    --reject-budget F          allowed 429 fraction
+    --p99-regression-pct P     p99 may grow P% over the baseline (50)
+    --throughput-floor-pct P   req/s may drop P% under the baseline (50)
+
+Exit codes: 0 pass / generated / replayed; 1 SLO violation (the
+regression gate failed); 2 usage, unreadable input, or preflight
+failure.  `replay` without `--baseline` just writes the report;
+`replay --baseline OLD.json` additionally diffs against it and exits 1
+on violation - the one-command perf-regression gate CI runs.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, Optional, Sequence
+
+from wavetpu.core.flags import split_flags as _split_flags
+from wavetpu.loadgen import report as lg_report
+from wavetpu.loadgen import runner, trace
+
+_USAGE = __doc__.split("Exit codes:")[0].strip()
+
+_SLO_FLAGS = {
+    "p99-budget-ms": ("p99_budget_ms", float),
+    "error-budget": ("error_budget", float),
+    "reject-budget": ("reject_budget", float),
+    "p99-regression-pct": ("p99_regression_pct", float),
+    "throughput-floor-pct": ("throughput_floor_pct", float),
+}
+
+
+def _slo_from_flags(flags: dict) -> Dict[str, float]:
+    slo = {}
+    for flag, (key, conv) in _SLO_FLAGS.items():
+        if flag in flags:
+            slo[key] = conv(flags[flag])
+    return slo
+
+
+def _usage_error(msg: str) -> int:
+    print(f"error: {msg}", file=sys.stderr)
+    print(_USAGE, file=sys.stderr)
+    return 2
+
+
+def _generate(argv: Sequence[str]) -> int:
+    try:
+        pos, flags = _split_flags(
+            argv,
+            known=("out", "mix", "duration", "qps", "seed", "n",
+                   "timesteps", "pallas", "distinct"),
+            valueless=("pallas",),
+        )
+        if pos:
+            raise ValueError(f"unexpected positional {pos[0]!r}")
+        if "out" not in flags:
+            raise ValueError("generate needs --out TRACE.jsonl")
+        mix = flags.get("mix", "poisson")
+        duration = float(flags.get("duration", "30"))
+        qps = float(flags.get("qps", "4"))
+        seed = int(flags.get("seed", "0"))
+        scenarios = trace.default_scenarios(
+            n=int(flags.get("n", "8")),
+            timesteps=int(flags.get("timesteps", "20")),
+            pallas="pallas" in flags,
+        )
+        kw = {}
+        if mix == "hotkey" and "distinct" in flags:
+            kw["distinct"] = int(flags["distinct"])
+        records = trace.generate(
+            mix, duration, qps, scenarios=scenarios, seed=seed, **kw
+        )
+    except ValueError as e:
+        return _usage_error(str(e))
+    trace.save_scenario_trace(flags["out"], records)
+    tiers = sorted({r["scenario"] for r in records})
+    print(
+        f"wrote {len(records)} requests / {len(tiers)} tiers "
+        f"({mix}, {duration:g}s @ {qps:g} qps, seed {seed}) "
+        f"-> {flags['out']}"
+    )
+    return 0
+
+
+def _run_gate(report: dict, baseline_path: str, slo: dict) -> int:
+    try:
+        baseline = lg_report.load_report(baseline_path)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        return _usage_error(f"cannot read baseline: {e}")
+    violations = lg_report.gate(report, baseline=baseline, slo=slo)
+    print(lg_report.format_gate(violations, report, baseline))
+    return 1 if violations else 0
+
+
+def _replay(argv: Sequence[str]) -> int:
+    try:
+        pos, flags = _split_flags(
+            argv,
+            known=("target", "mode", "concurrency", "speed", "warmup",
+                   "timeout", "out", "baseline", "no-preflight")
+            + tuple(_SLO_FLAGS),
+            valueless=("no-preflight",),
+        )
+        if len(pos) != 1:
+            raise ValueError("replay wants exactly one TRACE.jsonl")
+        if "target" not in flags:
+            raise ValueError("replay needs --target URL")
+        mode = flags.get("mode", "open")
+        concurrency = int(flags.get("concurrency", "4"))
+        speed = float(flags.get("speed", "1"))
+        warmup = int(flags.get("warmup", "0"))
+        timeout = float(flags.get("timeout", "120"))
+        slo = _slo_from_flags(flags)
+        records = trace.load_scenario_trace(pos[0])
+    except ValueError as e:
+        return _usage_error(str(e))
+    except OSError as e:
+        return _usage_error(f"cannot read trace: {e}")
+    try:
+        result = runner.replay(
+            flags["target"], records, mode=mode,
+            concurrency=concurrency, speed=speed, warmup=warmup,
+            timeout=timeout, skip_preflight="no-preflight" in flags,
+        )
+    except runner.PreflightError as e:
+        print(f"error: preflight failed: {e}", file=sys.stderr)
+        return 2
+    except ValueError as e:
+        return _usage_error(str(e))
+    report = lg_report.build_report(
+        result, trace_path=pos[0], target=flags["target"],
+    )
+    lat = report["latency_ms"]
+    occ = report["server"]["occupancy_mean"]
+    print(
+        f"replayed {report['requests']} requests in "
+        f"{report['wall_seconds']}s ({report['mode']} loop): "
+        f"ok {report['ok']}, 429 {report['rejected_429']}, errors "
+        f"{report['errors']}; p50 {lat['p50_ms']}ms p99 {lat['p99_ms']}ms; "
+        f"occupancy {occ}; cold compiles "
+        f"{report['server']['cold_compiles']}"
+    )
+    if "out" in flags:
+        with open(flags["out"], "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+        print(f"report written: {flags['out']}")
+    if "baseline" in flags:
+        return _run_gate(report, flags["baseline"], slo)
+    return 0
+
+
+def _gate(argv: Sequence[str]) -> int:
+    try:
+        pos, flags = _split_flags(
+            argv, known=("baseline",) + tuple(_SLO_FLAGS)
+        )
+        if len(pos) != 1:
+            raise ValueError("gate wants exactly one REPORT.json")
+        if "baseline" not in flags:
+            raise ValueError("gate needs --baseline OLD.json")
+        slo = _slo_from_flags(flags)
+    except ValueError as e:
+        return _usage_error(str(e))
+    try:
+        report = lg_report.load_report(pos[0])
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        return _usage_error(f"cannot read report: {e}")
+    return _run_gate(report, flags["baseline"], slo)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        return _usage_error("missing subcommand (generate|replay|gate)")
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "generate":
+        return _generate(rest)
+    if cmd == "replay":
+        return _replay(rest)
+    if cmd == "gate":
+        return _gate(rest)
+    return _usage_error(f"unknown subcommand {cmd!r}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,290 @@
+"""loadgen_report.json + the perf-regression gate.
+
+`build_report` turns one replay (client-side outcomes + the /metrics
+cuts bracketing it) into a machine-readable report:
+
+ * overall and PER-SCENARIO-TIER latency percentiles (p50/p95/p99,
+   nearest-rank - the same definition /metrics and trace-report use),
+ * outcome accounting: ok / 429-reject / error rates,
+ * mean Server-Timing attribution (queue vs compile vs execute vs
+   padding) overall and per tier - where the latency went, fleet-wide,
+ * server-side deltas for exactly the replayed window: batch occupancy,
+   padding-lane waste, cold-vs-warm compile counts, queue rejections,
+   aggregate Gcell/s,
+ * the slowest request ids - each joinable to its server-side critical
+   path via `wavetpu trace-report --request ID`.
+
+`gate(report, baseline, slo)` is the regression gate `wavetpu loadgen
+--baseline OLD.json` runs: absolute SLOs (p99 budget, error budget) and
+relative ones against the baseline report (p99 regression %, throughput
+floor %).  It returns a violation list; the CLI exits 1 when it is
+non-empty.  Defaults are deliberately loose enough for shared-chip
+noise (~+-15% solo-run variance measured across BENCH rounds) and tight
+enough that a 10x max-wait misconfiguration cannot pass.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional, Sequence
+
+from wavetpu.obs.report import percentile_nearest_rank
+
+# Gate defaults: see module docstring for the calibration argument.
+DEFAULT_SLO = {
+    "p99_budget_ms": None,        # absolute p99 cap (None = off)
+    "error_budget": 0.0,          # allowed non-ok non-429 fraction
+    "reject_budget": None,        # allowed 429 fraction (None = off)
+    "p99_regression_pct": 50.0,   # p99 may grow this % over baseline
+    "throughput_floor_pct": 50.0,  # req/s may drop this % under baseline
+}
+
+_TIMING_KEYS = ("queue", "compile", "execute", "padding")
+
+
+def _pcts(latencies_ms: Sequence[float]) -> Dict[str, Optional[float]]:
+    if not latencies_ms:
+        return {"p50_ms": None, "p95_ms": None, "p99_ms": None,
+                "mean_ms": None, "max_ms": None}
+    s = sorted(latencies_ms)
+    return {
+        "p50_ms": round(percentile_nearest_rank(s, 0.50), 3),
+        "p95_ms": round(percentile_nearest_rank(s, 0.95), 3),
+        "p99_ms": round(percentile_nearest_rank(s, 0.99), 3),
+        "mean_ms": round(sum(s) / len(s), 3),
+        "max_ms": round(s[-1], 3),
+    }
+
+
+def _delta(after: Dict[str, float], before: Dict[str, float],
+           name: str) -> float:
+    return after.get(name, 0.0) - before.get(name, 0.0)
+
+
+def build_report(result, trace_path: Optional[str] = None,
+                 target: Optional[str] = None,
+                 meta: Optional[dict] = None) -> dict:
+    """One replay -> the loadgen_report.json dict (see module doc).
+    `result` is a runner.ReplayResult."""
+    outs = result.outcomes
+    n = len(outs)
+    ok = sum(1 for o in outs if o.status == 200)
+    rejected = sum(1 for o in outs if o.status == 429)
+    errors = n - ok - rejected
+    lat_ms = [o.latency_s * 1e3 for o in outs]
+
+    tiers: Dict[str, dict] = {}
+    for tier in sorted({o.scenario for o in outs}):
+        sub = [o for o in outs if o.scenario == tier]
+        t_lat = [o.latency_s * 1e3 for o in sub]
+        t_ok = sum(1 for o in sub if o.status == 200)
+        row = {
+            "requests": len(sub),
+            "ok": t_ok,
+            "error_rate": round(1.0 - t_ok / len(sub), 4),
+        }
+        row.update(_pcts(t_lat))
+        st = [o.server_timing for o in sub if o.server_timing]
+        if st:
+            row["server_timing_mean_ms"] = {
+                k: round(
+                    sum(s.get(k, 0.0) for s in st) / len(st) * 1e3, 3
+                )
+                for k in _TIMING_KEYS
+            }
+        tiers[tier] = row
+
+    st_all = [o.server_timing for o in outs if o.server_timing]
+    timing_mean = {
+        k: round(
+            sum(s.get(k, 0.0) for s in st_all) / len(st_all) * 1e3, 3
+        )
+        for k in _TIMING_KEYS
+    } if st_all else None
+
+    before, after = result.metrics_before, result.metrics_after
+    occ_sum = _delta(after, before, "wavetpu_serve_batch_occupancy_sum")
+    occ_n = _delta(after, before, "wavetpu_serve_batch_occupancy_count")
+    cells = _delta(after, before, "wavetpu_serve_cells_total")
+    solve_s = _delta(after, before, "wavetpu_serve_solve_seconds_total")
+    server = {
+        "batches": int(occ_n),
+        "occupancy_mean": round(occ_sum / occ_n, 3) if occ_n else None,
+        "padding_lanes": int(_delta(
+            after, before, "wavetpu_serve_padding_lanes_total"
+        )),
+        "queue_rejected": int(_delta(
+            after, before, "wavetpu_serve_rejected_total"
+        )),
+        "limit_rejected": int(sum(
+            _delta(after, before, name)
+            for name in after
+            if name.startswith("wavetpu_serve_limit_rejected_total")
+        )),
+        "fallback_batches": int(_delta(
+            after, before, "wavetpu_serve_fallback_batches_total"
+        )),
+        # Cold-vs-warm program traffic during the replay window: misses
+        # are compiles the replay paid, hits are the warmed steady state.
+        "cold_compiles": int(_delta(
+            after, before,
+            'wavetpu_program_cache_events_total{event="miss"}',
+        )),
+        "warm_hits": int(_delta(
+            after, before,
+            'wavetpu_program_cache_events_total{event="hit"}',
+        )),
+        "evictions": int(_delta(
+            after, before,
+            'wavetpu_program_cache_events_total{event="eviction"}',
+        )),
+        "aggregate_gcells_per_s": (
+            round(cells / solve_s / 1e9, 4) if solve_s else None
+        ),
+    }
+
+    slowest = sorted(outs, key=lambda o: -o.latency_s)[:5]
+    report = {
+        "loadgen_report": True,
+        "generated_unix": round(time.time(), 3),
+        "target": target,
+        "trace": trace_path,
+        "mode": result.mode,
+        "concurrency": result.concurrency,
+        "speed": result.speed,
+        "warmup_requests": len(result.warmup_outcomes),
+        "wall_seconds": round(result.wall_seconds, 3),
+        "requests": n,
+        "ok": ok,
+        "rejected_429": rejected,
+        "errors": errors,
+        "reject_rate": round(rejected / n, 4) if n else None,
+        "error_rate": round(errors / n, 4) if n else None,
+        "requests_per_s": (
+            round(n / result.wall_seconds, 3)
+            if result.wall_seconds else None
+        ),
+        "latency_ms": _pcts(lat_ms),
+        "server_timing_mean_ms": timing_mean,
+        "tiers": tiers,
+        "server": server,
+        # The join handles: feed any of these to
+        # `wavetpu trace-report --request ID` against the server's
+        # telemetry dir to see that exact request's critical path.
+        "slowest_requests": [
+            {
+                "request_id": o.request_id,
+                "scenario": o.scenario,
+                "status": o.status,
+                "latency_ms": round(o.latency_s * 1e3, 3),
+            }
+            for o in slowest
+        ],
+    }
+    if meta:
+        report["meta"] = meta
+    return report
+
+
+def load_report(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        report = json.load(f)
+    if not isinstance(report, dict) or not report.get("loadgen_report"):
+        raise ValueError(f"{path} is not a loadgen report")
+    return report
+
+
+def gate(report: dict, baseline: Optional[dict] = None,
+         slo: Optional[dict] = None) -> List[dict]:
+    """Evaluate the SLOs; returns the violation list (empty = pass).
+    Absolute gates (p99 budget, error/reject budgets) always apply;
+    relative gates (p99 regression, throughput floor) need `baseline`."""
+    cfg = dict(DEFAULT_SLO)
+    if slo:
+        unknown = set(slo) - set(DEFAULT_SLO)
+        if unknown:
+            raise ValueError(f"unknown SLO keys {sorted(unknown)}")
+        cfg.update({k: v for k, v in slo.items() if v is not None})
+    out: List[dict] = []
+
+    def fail(name, observed, budget, detail):
+        out.append({"slo": name, "observed": observed,
+                    "budget": budget, "detail": detail})
+
+    p99 = (report.get("latency_ms") or {}).get("p99_ms")
+    if cfg["p99_budget_ms"] is not None:
+        if p99 is None or p99 > cfg["p99_budget_ms"]:
+            fail("p99_budget_ms", p99, cfg["p99_budget_ms"],
+                 f"p99 {p99} ms exceeds budget "
+                 f"{cfg['p99_budget_ms']} ms")
+    err = report.get("error_rate")
+    if cfg["error_budget"] is not None and err is not None \
+            and err > cfg["error_budget"]:
+        fail("error_budget", err, cfg["error_budget"],
+             f"error rate {err} exceeds budget {cfg['error_budget']}")
+    rej = report.get("reject_rate")
+    if cfg["reject_budget"] is not None and rej is not None \
+            and rej > cfg["reject_budget"]:
+        fail("reject_budget", rej, cfg["reject_budget"],
+             f"429 reject rate {rej} exceeds budget "
+             f"{cfg['reject_budget']}")
+
+    if baseline is not None:
+        base_p99 = (baseline.get("latency_ms") or {}).get("p99_ms")
+        if cfg["p99_regression_pct"] is not None and base_p99 and p99:
+            limit = base_p99 * (1.0 + cfg["p99_regression_pct"] / 100.0)
+            if p99 > limit:
+                fail("p99_regression_pct",
+                     round(100.0 * (p99 / base_p99 - 1.0), 1),
+                     cfg["p99_regression_pct"],
+                     f"p99 {p99} ms vs baseline {base_p99} ms "
+                     f"(+{100.0 * (p99 / base_p99 - 1.0):.1f}% > "
+                     f"+{cfg['p99_regression_pct']}% allowed)")
+        base_rps = baseline.get("requests_per_s")
+        rps = report.get("requests_per_s")
+        if cfg["throughput_floor_pct"] is not None and base_rps and rps:
+            floor = base_rps * (1.0 - cfg["throughput_floor_pct"] / 100.0)
+            if rps < floor:
+                fail("throughput_floor_pct",
+                     round(100.0 * (1.0 - rps / base_rps), 1),
+                     cfg["throughput_floor_pct"],
+                     f"throughput {rps} req/s vs baseline {base_rps} "
+                     f"req/s (-{100.0 * (1.0 - rps / base_rps):.1f}% > "
+                     f"-{cfg['throughput_floor_pct']}% allowed)")
+    return out
+
+
+def format_gate(violations: Sequence[dict], report: dict,
+                baseline: Optional[dict] = None) -> str:
+    """The human-readable gate diff (also a useful CI artifact)."""
+    lines = ["loadgen regression gate"]
+
+    def row(label, new, old, unit=""):
+        if old is not None and new is not None and old:
+            pct = 100.0 * (new / old - 1.0)
+            lines.append(
+                f"  {label:<18} {new:>10} vs {old:>10} {unit} "
+                f"({pct:+.1f}%)"
+            )
+        else:
+            lines.append(f"  {label:<18} {new!r:>10} (no baseline)")
+
+    lat = report.get("latency_ms") or {}
+    blat = (baseline or {}).get("latency_ms") or {}
+    row("p50_ms", lat.get("p50_ms"), blat.get("p50_ms"), "ms")
+    row("p99_ms", lat.get("p99_ms"), blat.get("p99_ms"), "ms")
+    row("requests_per_s", report.get("requests_per_s"),
+        (baseline or {}).get("requests_per_s"), "req/s")
+    lines.append(
+        f"  {'error_rate':<18} {report.get('error_rate')!r:>10}"
+        f"   reject_rate {report.get('reject_rate')!r}"
+    )
+    if violations:
+        lines.append("violations:")
+        for v in violations:
+            lines.append(f"  FAIL [{v['slo']}] {v['detail']}")
+        lines.append("-> FAIL")
+    else:
+        lines.append("-> PASS")
+    return "\n".join(lines)

@@ -1,0 +1,305 @@
+"""Replay a scenario trace against a live `wavetpu serve`.
+
+Two drive modes (the standard loadgen pair):
+
+ * OPEN loop - fire each request at its trace timestamp (optionally
+   time-scaled by `speed`), regardless of whether earlier requests have
+   returned: measures the server under the OFFERED load, including
+   queue growth and 429 shedding.  This is the mode arrival-process
+   realism (poisson / diurnal traces) exists for.
+ * CLOSED loop - `concurrency` workers each hold at most one request in
+   flight and send the next the moment the previous returns, ignoring
+   timestamps: measures sustainable throughput and per-request latency
+   at a fixed multiprogramming level.
+
+Both modes run an optional WARMUP phase first (one request per distinct
+scenario tier, excluded from the measurement) so a report's p99 is the
+steady state, not the first-contact compile - unless the trace is
+explicitly cache-adversarial (hotkey mix), where warmup is the thing
+being measured and should be 0.
+
+Every request carries a minted `X-Request-Id` header; the server echoes
+it, tags its trace spans with it, and pins it as the exemplar on the
+latency histogram bucket - so any outlier in the client-side report is
+joinable to its server-side critical path via
+`wavetpu trace-report --request ID`.  The response's `Server-Timing`
+header is parsed into per-request queue/compile/execute/padding
+seconds.  `/metrics` (Prometheus text view) is scraped before and after
+the measured phase; the report layer turns the deltas into occupancy,
+padding-waste, reject-rate and cold-vs-warm compile numbers for exactly
+the replayed window.
+
+Pure stdlib; never imports jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Sequence
+
+
+class PreflightError(RuntimeError):
+    """The target server failed the health preflight - replaying a
+    trace at a down/draining server would produce a garbage report."""
+
+
+def _get(url: str, timeout: float, accept: Optional[str] = None):
+    req = urllib.request.Request(
+        url, headers={"Accept": accept} if accept else {}
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+def preflight(base_url: str, timeout: float = 10.0) -> dict:
+    """Assert the target is alive and accepting BEFORE replay: /healthz
+    must answer 200 with status ok and draining false.  Returns the
+    health payload (uptime, last_batch_age_seconds - null means the
+    server has never executed a batch, i.e. replay starts cold)."""
+    url = base_url.rstrip("/") + "/healthz"
+    try:
+        status, text = _get(url, timeout)
+        health = json.loads(text)
+    except (OSError, ValueError, urllib.error.URLError) as e:
+        raise PreflightError(f"cannot reach {url}: {e}")
+    if status != 200 or health.get("status") != "ok":
+        raise PreflightError(f"{url} unhealthy: {health}")
+    if health.get("draining"):
+        raise PreflightError(f"{url} is draining (shutting down)")
+    return health
+
+
+def parse_prometheus_text(text: str) -> Dict[str, float]:
+    """Minimal Prometheus 0.0.4 text parser: {sample_name_with_labels:
+    value}.  Enough for metric deltas; exemplar suffixes and # EOF (the
+    OpenMetrics render) are tolerated but the loadgen scrapes the plain
+    text view anyway."""
+    samples: Dict[str, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        if " # " in line:  # OpenMetrics exemplar suffix
+            line = line.split(" # ", 1)[0]
+        name, _, value = line.rpartition(" ")
+        if not name:
+            continue
+        try:
+            samples[name] = float(value.replace("+Inf", "inf"))
+        except ValueError:
+            continue
+    return samples
+
+
+def scrape_metrics(base_url: str, timeout: float = 30.0
+                   ) -> Dict[str, float]:
+    """One consistent /metrics cut in the Prometheus text view (it
+    carries cells/solve-seconds/occupancy-sum counters the JSON
+    snapshot summarizes away)."""
+    _, text = _get(
+        base_url.rstrip("/") + "/metrics", timeout, accept="text/plain"
+    )
+    return parse_prometheus_text(text)
+
+
+def parse_server_timing(header: Optional[str]) -> Dict[str, float]:
+    """`queue;dur=1.2, execute;dur=45` -> {"queue": 0.0012, ...}
+    (seconds).  Unparseable entries are skipped - the report must not
+    die on a proxy that rewrites headers."""
+    out: Dict[str, float] = {}
+    if not header:
+        return out
+    for part in header.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, params = part.partition(";")
+        for p in params.split(";"):
+            k, _, v = p.strip().partition("=")
+            if k == "dur":
+                try:
+                    out[name.strip()] = float(v) / 1e3
+                except ValueError:
+                    pass
+    return out
+
+
+@dataclasses.dataclass
+class RequestOutcome:
+    """One replayed request, client-side view + parsed Server-Timing."""
+
+    index: int
+    scenario: str
+    request_id: str
+    status: int            # HTTP status; 0 = transport error/timeout
+    latency_s: float
+    t_sent: float          # offset from replay start
+    server_timing: Dict[str, float] = dataclasses.field(
+        default_factory=dict
+    )
+    error: Optional[str] = None
+
+
+@dataclasses.dataclass
+class ReplayResult:
+    outcomes: List[RequestOutcome]
+    warmup_outcomes: List[RequestOutcome]
+    metrics_before: Dict[str, float]
+    metrics_after: Dict[str, float]
+    wall_seconds: float
+    mode: str
+    concurrency: int
+    speed: float
+
+
+def _post_one(base_url: str, index: int, rec: dict, rid: str,
+              t_sent: float, timeout: float) -> RequestOutcome:
+    body = json.dumps(rec["body"]).encode()
+    req = urllib.request.Request(
+        base_url.rstrip("/") + "/solve", data=body,
+        headers={
+            "Content-Type": "application/json",
+            "X-Request-Id": rid,
+        },
+    )
+    t0 = time.perf_counter()
+    status, timing, err = 0, {}, None
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            r.read()
+            status = r.status
+            timing = parse_server_timing(r.headers.get("Server-Timing"))
+    except urllib.error.HTTPError as e:
+        status = e.code
+        timing = parse_server_timing(e.headers.get("Server-Timing"))
+        try:
+            err = json.loads(e.read()).get("error")
+        except Exception:
+            err = str(e)
+    except (OSError, urllib.error.URLError) as e:
+        err = str(e)
+    return RequestOutcome(
+        index=index, scenario=rec.get("scenario", "?"), request_id=rid,
+        status=status, latency_s=time.perf_counter() - t0,
+        t_sent=t_sent, server_timing=timing, error=err,
+    )
+
+
+def _mint_rid(run_tag: str, index: int) -> str:
+    return f"lg-{run_tag}-{index}"
+
+
+def replay(
+    base_url: str,
+    records: Sequence[dict],
+    mode: str = "open",
+    concurrency: int = 4,
+    speed: float = 1.0,
+    warmup: int = 0,
+    timeout: float = 120.0,
+    run_tag: Optional[str] = None,
+    skip_preflight: bool = False,
+) -> ReplayResult:
+    """Drive `records` at `base_url`; returns outcomes + the /metrics
+    cuts bracketing the measured phase.  `warmup` > 0 first serves up
+    to that many requests - one per distinct scenario, sequential,
+    excluded from the measurement - so steady-state numbers are not
+    first-compile numbers.  `speed` > 1 time-compresses an open-loop
+    trace (a 300 s recorded trace replayed at speed=10 offers 10x the
+    QPS in 30 s)."""
+    if mode not in ("open", "closed"):
+        raise ValueError(f"mode must be open|closed, got {mode!r}")
+    if concurrency < 1:
+        raise ValueError(f"concurrency must be >= 1, got {concurrency}")
+    if speed <= 0:
+        raise ValueError(f"speed must be > 0, got {speed}")
+    records = list(records)
+    if not records:
+        raise ValueError("empty trace")
+    if not skip_preflight:
+        preflight(base_url)
+    if run_tag is None:
+        # Unique enough across replays against one server; hex keeps it
+        # inside the server's sanitized request-id alphabet.
+        run_tag = f"{int(time.time() * 1e3) & 0xFFFFFFFF:x}"
+
+    warmup_outcomes: List[RequestOutcome] = []
+    if warmup > 0:
+        seen = set()
+        wi = 0
+        for rec in records:
+            tier = rec.get("scenario", "?")
+            if tier in seen or len(warmup_outcomes) >= warmup:
+                continue
+            seen.add(tier)
+            warmup_outcomes.append(_post_one(
+                base_url, wi, rec, _mint_rid(run_tag + "w", wi), 0.0,
+                timeout,
+            ))
+            wi += 1
+
+    metrics_before = scrape_metrics(base_url)
+    outcomes: List[Optional[RequestOutcome]] = [None] * len(records)
+    t_start = time.perf_counter()
+
+    def fire(i: int, rec: dict) -> None:
+        outcomes[i] = _post_one(
+            base_url, i, rec, _mint_rid(run_tag, i),
+            time.perf_counter() - t_start, timeout,
+        )
+
+    if mode == "open":
+        threads = []
+        for i, rec in enumerate(records):
+            delay = rec.get("t", 0.0) / speed - (
+                time.perf_counter() - t_start
+            )
+            if delay > 0:
+                time.sleep(delay)
+            th = threading.Thread(target=fire, args=(i, rec), daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout + 30.0)
+    else:
+        nxt = {"i": 0}
+        lock = threading.Lock()
+
+        def worker():
+            while True:
+                with lock:
+                    i = nxt["i"]
+                    if i >= len(records):
+                        return
+                    nxt["i"] = i + 1
+                fire(i, records[i])
+
+        threads = [
+            threading.Thread(target=worker, daemon=True)
+            for _ in range(min(concurrency, len(records)))
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout * len(records) + 30.0)
+
+    wall = time.perf_counter() - t_start
+    metrics_after = scrape_metrics(base_url)
+    done = [
+        o if o is not None else RequestOutcome(
+            index=i, scenario=records[i].get("scenario", "?"),
+            request_id=_mint_rid(run_tag, i), status=0,
+            latency_s=timeout, t_sent=0.0, error="never completed",
+        )
+        for i, o in enumerate(outcomes)
+    ]
+    return ReplayResult(
+        outcomes=done, warmup_outcomes=warmup_outcomes,
+        metrics_before=metrics_before, metrics_after=metrics_after,
+        wall_seconds=wall, mode=mode, concurrency=concurrency,
+        speed=speed,
+    )

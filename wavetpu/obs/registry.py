@@ -25,7 +25,13 @@ Instruments:
  * `Gauge`   - settable float (`.set(v)` / `.inc` / `.dec`).
  * `Histogram` - fixed cumulative buckets + sum + count
    (`.observe(v)`); renders the standard `_bucket{le=...}`, `_sum`,
-   `_count` sample triplet.
+   `_count` sample triplet.  `observe(v, exemplar={...})` additionally
+   pins an OpenMetrics exemplar (e.g. a request id) to the bucket the
+   observation landed in, so a scraped p99 bucket is JOINABLE to the
+   exact trace record that filled it (`wavetpu trace-report --request`).
+   Exemplars only render under `render_prometheus(openmetrics=True)` -
+   the classic 0.0.4 text view stays byte-stable for parsers that do
+   not speak the `# {label="v"} value ts` suffix.
 
 Labels: declare `labelnames` at registration, address a child with
 keyword labels on every call (`c.inc(1, path="kfused")`).  Re-registering
@@ -122,9 +128,15 @@ class Counter(_Metric):
         with self._registry.lock:
             return self._values.get(key, 0.0)
 
-    def _samples(self) -> List[Tuple[str, float]]:
+    def total(self) -> float:
+        """Sum over every label child (the JSON snapshot's single-number
+        view of a labeled counter)."""
+        with self._registry.lock:
+            return sum(self._values.values())
+
+    def _samples(self) -> List[Tuple[str, float, Optional[str]]]:
         return [
-            (self.name + self._labelstr(key), v)
+            (self.name + self._labelstr(key), v, None)
             for key, v in sorted(self._values.items())
         ]
 
@@ -179,8 +191,13 @@ class Histogram(_Metric):
         self.buckets = bs
         # key -> (per-bucket counts, +Inf count, sum)
         self._values: Dict[Tuple[str, ...], list] = {}
+        # key -> {bucket index (len(buckets) = +Inf) -> (labels, v, ts)}:
+        # the LATEST exemplar per bucket, OpenMetrics-rendered.
+        self._exemplars: Dict[Tuple[str, ...], dict] = {}
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float,
+                exemplar: Optional[Dict[str, str]] = None,
+                **labels) -> None:
         key = self._key(labels)
         v = float(value)
         with self._registry.lock:
@@ -188,11 +205,17 @@ class Histogram(_Metric):
             if slot is None:
                 slot = [[0] * len(self.buckets), 0, 0.0]
                 self._values[key] = slot
+            landed = len(self.buckets)  # +Inf unless a bound catches it
             for i, b in enumerate(self.buckets):
                 if v <= b:
                     slot[0][i] += 1
+                    landed = min(landed, i)
             slot[1] += 1
             slot[2] += v
+            if exemplar:
+                self._exemplars.setdefault(key, {})[landed] = (
+                    dict(exemplar), v, time.time()
+                )
 
     def count(self, **labels) -> int:
         key = self._key(labels)
@@ -200,21 +223,34 @@ class Histogram(_Metric):
             slot = self._values.get(key)
             return 0 if slot is None else slot[1]
 
-    def _samples(self) -> List[Tuple[str, float]]:
+    def _exemplar_str(self, key: Tuple[str, ...], idx: int) -> Optional[str]:
+        ex = self._exemplars.get(key, {}).get(idx)
+        if ex is None:
+            return None
+        labels, v, ts = ex
+        body = ",".join(
+            f'{n}="{escape_label_value(x)}"' for n, x in sorted(labels.items())
+        )
+        return f"# {{{body}}} {format_value(v)} {round(ts, 3)}"
+
+    def _samples(self) -> List[Tuple[str, float, Optional[str]]]:
         out = []
         for key, (counts, total, vsum) in sorted(self._values.items()):
-            for b, c in zip(self.buckets, counts):
+            for i, (b, c) in enumerate(zip(self.buckets, counts)):
                 out.append((
                     self.name + "_bucket"
                     + self._labelstr(key, ("le", format_value(b))),
                     c,
+                    self._exemplar_str(key, i),
                 ))
             out.append((
                 self.name + "_bucket" + self._labelstr(key, ("le", "+Inf")),
                 total,
+                self._exemplar_str(key, len(self.buckets)),
             ))
-            out.append((self.name + "_sum" + self._labelstr(key), vsum))
-            out.append((self.name + "_count" + self._labelstr(key), total))
+            out.append((self.name + "_sum" + self._labelstr(key), vsum, None))
+            out.append((self.name + "_count" + self._labelstr(key), total,
+                        None))
         return out
 
     def _snapshot_value(self):
@@ -295,15 +331,34 @@ class MetricsRegistry:
                 for name, m in sorted(self._metrics.items())
             }
 
-    def render_prometheus(self) -> str:
-        """Text exposition format 0.0.4 - one consistent cut."""
+    def render_prometheus(self, openmetrics: bool = False) -> str:
+        """Text exposition - one consistent cut.
+
+        `openmetrics=False` (the default) is the classic 0.0.4 format
+        every textfile collector parses; `openmetrics=True` renders the
+        same families with histogram EXEMPLARS (`# {request_id="..."} v
+        ts` bucket suffixes) and the `# EOF` terminator - the subset of
+        OpenMetrics the serve layer content-negotiates for
+        `Accept: application/openmetrics-text` scrapes."""
         with self.lock:
             lines = []
             for name, m in sorted(self._metrics.items()):
-                lines.append(f"# HELP {name} {escape_help(m.help)}")
-                lines.append(f"# TYPE {name} {m.kind}")
-                for sample, value in m._samples():
-                    lines.append(f"{sample} {format_value(value)}")
+                family = name
+                if (openmetrics and m.kind == "counter"
+                        and name.endswith("_total")):
+                    # OpenMetrics names a counter FAMILY without the
+                    # _total suffix; the samples keep it.  The 0.0.4
+                    # view keeps the historical full-name TYPE line.
+                    family = name[: -len("_total")]
+                lines.append(f"# HELP {family} {escape_help(m.help)}")
+                lines.append(f"# TYPE {family} {m.kind}")
+                for sample, value, exemplar in m._samples():
+                    line = f"{sample} {format_value(value)}"
+                    if openmetrics and exemplar is not None:
+                        line += f" {exemplar}"
+                    lines.append(line)
+            if openmetrics:
+                lines.append("# EOF")
             return "\n".join(lines) + "\n"
 
 

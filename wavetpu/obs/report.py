@@ -17,6 +17,7 @@ this against a live run's telemetry dir without touching the backend).
 from __future__ import annotations
 
 import json
+import os
 import sys
 from typing import Dict, List, Optional, Sequence
 
@@ -26,22 +27,45 @@ _USAGE = (
 )
 
 
-def load_trace(path: str) -> List[dict]:
+def trace_segments(path: str) -> List[str]:
+    """The rotated segment set for a trace path, OLDEST FIRST: the size
+    rotation (obs/tracing.py `rotate_file`) shifts trace.jsonl ->
+    trace.jsonl.1 -> .2 ..., so higher suffixes are older and the live
+    file is newest.  A never-rotated trace is just [path]."""
+    old = []
+    i = 1
+    while os.path.exists(f"{path}.{i}"):
+        old.append(f"{path}.{i}")
+        i += 1
+    return list(reversed(old)) + [path]
+
+
+def load_trace(path: str, include_rotated: bool = True) -> List[dict]:
     """Parse a JSONL trace; malformed lines are counted, not fatal (the
-    file may be mid-write when an operator runs the report)."""
+    file may be mid-write when an operator runs the report).  Rotated
+    segments (`path.1`, `path.2`, ...) are read too, oldest first, so a
+    long-lived server's report covers the whole retained window."""
     records, bad = [], 0
-    with open(path, encoding="utf-8") as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                bad += 1
-                continue
-            if isinstance(rec, dict) and "kind" in rec:
-                records.append(rec)
+    segments = trace_segments(path) if include_rotated else [path]
+    for seg in segments:
+        try:
+            f = open(seg, encoding="utf-8")
+        except OSError:
+            if seg == path:
+                raise  # the live file must exist; segments may race GC
+            continue
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    bad += 1
+                    continue
+                if isinstance(rec, dict) and "kind" in rec:
+                    records.append(rec)
     if bad:
         print(f"note: skipped {bad} malformed line(s)", file=sys.stderr)
     return records

@@ -34,21 +34,33 @@ TRACE_FILENAME = "trace.jsonl"
 HEARTBEAT_FILENAME = "heartbeat.jsonl"
 PROM_FILENAME = "metrics.prom"
 
+# Size cap per telemetry file before rotation (keep-last-ROTATE_KEEP
+# segments, atomic os.replace shifts): a long-lived `wavetpu serve`
+# under sustained traffic must not append trace.jsonl/heartbeat.jsonl
+# forever.  64 MiB x 4 segments bounds the dir at ~512 MiB worst case
+# while keeping hours of serve spans at production request rates.
+DEFAULT_MAX_BYTES = 64 * 1024 * 1024
+ROTATE_KEEP = 4
+
 
 class Telemetry:
     def __init__(self, directory: str,
                  registry: Optional[MetricsRegistry] = None,
-                 interval: float = 10.0):
+                 interval: float = 10.0,
+                 max_bytes: Optional[int] = DEFAULT_MAX_BYTES,
+                 keep: int = ROTATE_KEEP):
         if interval <= 0:
             raise ValueError(f"interval must be > 0, got {interval}")
         self.directory = directory
         self.registry = registry if registry is not None else get_registry()
         self.interval = interval
+        self.max_bytes = max_bytes
+        self.keep = max(1, int(keep))
         os.makedirs(directory, exist_ok=True)
         self.trace_path = os.path.join(directory, TRACE_FILENAME)
         self.heartbeat_path = os.path.join(directory, HEARTBEAT_FILENAME)
         self.prom_path = os.path.join(directory, PROM_FILENAME)
-        tracing.configure(self.trace_path)
+        tracing.configure(self.trace_path, max_bytes=max_bytes, keep=keep)
         self._stop = threading.Event()
         self._stopped = False
         self._thread = threading.Thread(
@@ -63,11 +75,20 @@ class Telemetry:
         atexit.register(self.stop)
 
     def beat(self) -> None:
-        """Write one heartbeat line + refresh the Prometheus dump."""
+        """Write one heartbeat line + refresh the Prometheus dump.
+        The heartbeat file rotates like the trace (size cap, keep-last-K
+        atomic segment shift) - a week-long server cannot grow it
+        unbounded."""
         snap = {
             "ts": round(time.time(), 3),
             "metrics": self.registry.snapshot(),
         }
+        if self.max_bytes is not None:
+            try:
+                if os.path.getsize(self.heartbeat_path) > self.max_bytes:
+                    tracing.rotate_file(self.heartbeat_path, self.keep)
+            except OSError:
+                pass  # not created yet
         with open(self.heartbeat_path, "a", encoding="utf-8") as f:
             f.write(json.dumps(snap) + "\n")
         tmp = f"{self.prom_path}.tmp-{os.getpid()}"
@@ -103,5 +124,8 @@ class Telemetry:
 
 
 def start(directory: str, registry: Optional[MetricsRegistry] = None,
-          interval: float = 10.0) -> Telemetry:
-    return Telemetry(directory, registry=registry, interval=interval)
+          interval: float = 10.0,
+          max_bytes: Optional[int] = DEFAULT_MAX_BYTES,
+          keep: int = ROTATE_KEEP) -> Telemetry:
+    return Telemetry(directory, registry=registry, interval=interval,
+                     max_bytes=max_bytes, keep=keep)

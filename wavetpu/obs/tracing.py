@@ -50,11 +50,40 @@ import time
 from typing import Optional
 
 
-class Tracer:
-    """JSONL span writer bound to one output file (append mode)."""
+def rotate_file(path: str, keep: int) -> None:
+    """Size-rotation shift: path -> path.1 -> ... -> path.{keep-1}, the
+    oldest segment dropped.  Every move is an atomic `os.replace`, so a
+    concurrent reader (trace-report on a live dir) sees whole segments,
+    never a half-renamed set.  `keep` counts TOTAL retained segments
+    including the live file; keep=1 means rotation just truncates."""
+    keep = max(1, int(keep))
+    if keep == 1:
+        try:
+            os.replace(path, path + ".dropped")
+            os.remove(path + ".dropped")
+        except OSError:
+            pass
+        return
+    for i in range(keep - 1, 0, -1):
+        src = path if i == 1 else f"{path}.{i - 1}"
+        if os.path.exists(src):
+            os.replace(src, f"{path}.{i}")
 
-    def __init__(self, path: str):
+
+class Tracer:
+    """JSONL span writer bound to one output file (append mode).
+
+    `max_bytes` caps the live segment: a write that would exceed it
+    first rotates (`rotate_file`, keep-last-`keep` segments), so a
+    long-lived server's trace.jsonl cannot append forever.  Rotation
+    happens under the write lock; `wavetpu trace-report` reads the
+    whole rotated segment set (obs/report.py)."""
+
+    def __init__(self, path: str, max_bytes: Optional[int] = None,
+                 keep: int = 4):
         self.path = path
+        self.max_bytes = max_bytes
+        self.keep = max(1, int(keep))
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
@@ -88,6 +117,14 @@ class Tracer:
         line = json.dumps(record, default=str)
         try:
             with self._wlock:
+                if (
+                    self.max_bytes is not None
+                    and self._f.tell() > 0
+                    and self._f.tell() + len(line) + 1 > self.max_bytes
+                ):
+                    self._f.close()
+                    rotate_file(self.path, self.keep)
+                    self._f = open(self.path, "a", encoding="utf-8")
                 self._f.write(line + "\n")
                 self._f.flush()
         except (OSError, ValueError):
@@ -180,13 +217,17 @@ _tracer: Optional[Tracer] = None
 _config_lock = threading.Lock()
 
 
-def configure(path: str) -> Tracer:
-    """Start (or replace) the process tracer, writing JSONL to `path`."""
+def configure(path: str, max_bytes: Optional[int] = None,
+              keep: int = 4) -> Tracer:
+    """Start (or replace) the process tracer, writing JSONL to `path`.
+    `max_bytes`/`keep` turn on size-based segment rotation (the
+    telemetry layer passes its defaults; direct callers - tests - get
+    an unrotated file unless they ask)."""
     global _tracer
     with _config_lock:
         if _tracer is not None:
             _tracer.close()
-        _tracer = Tracer(path)
+        _tracer = Tracer(path, max_bytes=max_bytes, keep=keep)
         return _tracer
 
 

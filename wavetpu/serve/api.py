@@ -10,16 +10,33 @@ Endpoints (contract in docs/serving.md):
                  context (occupancy, batched-or-fallback, path).  With
                  --max-queue set, a full queue answers 429 (bounded-
                  queue backpressure) instead of building latency.
+                 Every response echoes the request id (`X-Request-Id`:
+                 the caller's header if supplied, else server-minted
+                 when tracing is on) and carries a `Server-Timing`
+                 header attributing the latency - queue/compile/
+                 execute (additive; sum ~= total) plus padding (the
+                 masked-lane share of the batch solve) and total (the
+                 server-measured wall) - so a load generator reads
+                 WHERE each request's time went without touching the
+                 server's trace files, and the id joins the outlier to
+                 `wavetpu trace-report --request ID`.
+                 --max-body-bytes refuses oversized bodies with 413 and
+                 --max-lane-cells refuses oversized grids with 422,
+                 both BEFORE scheduling (counted in /metrics).
   GET /healthz   liveness AND wedge detection: {"status": "ok",
                  "uptime_seconds", "draining", "last_batch_age_seconds"}
                  - a load balancer distinguishes idle (no traffic, age
-                 null/stale but draining false) from wedged.
+                 null/stale but draining false) from wedged; age is
+                 null ONLY if no batch was ever executed.
   GET /metrics   request counts, batch occupancy, p50/p95 latency,
                  aggregate Gcell/s, queue depth/rejections, program-
                  cache and fallback state.  Content-negotiated: the
                  default is the historical JSON snapshot; `Accept:
-                 text/plain` serves Prometheus text exposition from the
-                 same registry cut (docs/observability.md).
+                 text/plain` serves Prometheus text exposition and
+                 `Accept: application/openmetrics-text` the OpenMetrics
+                 form with request-id EXEMPLARS on latency histogram
+                 buckets, all from the same registry cut
+                 (docs/observability.md).
 
 Request fields: N (required), Np, Lx, Ly, Lz (floats or "pi"), T,
 timesteps, phase (initial time phase, default 2*pi), steps (stop layer,
@@ -60,40 +77,28 @@ _USAGE = (
     "usage: wavetpu serve [--host H] [--port P] [--max-batch B] "
     "[--max-wait-ms MS] [--bucket-sizes 1,2,4,8] [--max-programs M] "
     "[--length-bucket-steps Q] [--max-queue Q] "
+    "[--max-body-bytes B] [--max-lane-cells C] "
     "[--kernel auto|roll|pallas] "
-    "[--no-errors] [--max-amp X] [--no-watchdog] "
+    "[--no-errors] [--max-amp X] [--no-watchdog] [--no-server-timing] "
     "[--warmup N,TIMESTEPS[,K]] [--platform NAME] "
-    "[--telemetry-dir DIR] [--version]"
+    "[--telemetry-dir DIR] [--record-trace FILE.jsonl] [--version]"
 )
 
 _KNOWN = (
     "host", "port", "max-batch", "max-wait-ms", "bucket-sizes",
-    "max-programs", "length-bucket-steps", "max-queue", "kernel",
-    "no-errors", "max-amp", "no-watchdog", "warmup", "platform",
-    "telemetry-dir", "version",
+    "max-programs", "length-bucket-steps", "max-queue",
+    "max-body-bytes", "max-lane-cells", "kernel",
+    "no-errors", "max-amp", "no-watchdog", "no-server-timing",
+    "warmup", "platform", "telemetry-dir", "record-trace", "version",
 )
-_VALUELESS = ("no-errors", "no-watchdog", "version")
+_VALUELESS = ("no-errors", "no-watchdog", "no-server-timing", "version")
 
 
 def _split_flags(argv: Sequence[str]) -> dict:
-    flags = {}
-    it = iter(argv)
-    for a in it:
-        if not a.startswith("--"):
-            raise ValueError(f"unexpected positional {a!r}")
-        if "=" in a:
-            k, v = a[2:].split("=", 1)
-        else:
-            k = a[2:]
-            if k in _VALUELESS:
-                v = ""
-            else:
-                v = next(it, None)
-                if v is None:
-                    raise ValueError(f"flag --{k} needs a value")
-        if k not in _KNOWN:
-            raise ValueError(f"unknown flag --{k}")
-        flags[k] = v
+    from wavetpu.core.flags import split_flags
+
+    _, flags = split_flags(argv, _KNOWN, _VALUELESS,
+                           allow_positionals=False)
     return flags
 
 
@@ -264,20 +269,66 @@ def _ok_payload(result, batch_info: dict, errors_computed: bool) -> dict:
     }
 
 
+_RID_ALLOWED = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789-_.:"
+)
+
+
+def sanitize_request_id(raw: Optional[str]) -> Optional[str]:
+    """A caller-supplied X-Request-Id, accepted only when it is plainly
+    a token (<= 64 chars from [-A-Za-z0-9_.:]) - anything else is
+    dropped so header junk can never be reflected into responses, trace
+    attrs, or exemplar labels."""
+    if not raw:
+        return None
+    raw = raw.strip()
+    if not raw or len(raw) > 64 or not set(raw) <= _RID_ALLOWED:
+        return None
+    return raw
+
+
+def server_timing_header(timing: dict, total_s: float) -> str:
+    """RFC-style `Server-Timing` value from the scheduler's per-request
+    attribution: queue/compile/execute are the ADDITIVE wall components
+    (their sum ~= total up to parse/serialize overhead - the 10%
+    contract tests/test_serve.py pins), padding is the informational
+    masked-lane share of execute, total is the server-measured wall."""
+    parts = []
+    for name, key in (("queue", "queue_s"), ("compile", "compile_s"),
+                      ("execute", "execute_s"), ("padding", "padding_s")):
+        parts.append(f"{name};dur={timing.get(key, 0.0) * 1e3:.3f}")
+    parts.append(f"total;dur={total_s * 1e3:.3f}")
+    return ", ".join(parts)
+
+
 class ServerState:
     """Everything the handler needs, hung off the HTTPServer instance.
 
     `draining` flips on SIGTERM/SIGINT: new /solve requests get 503
     while the batcher flushes what is already queued (graceful drain -
-    outstanding futures resolve with results, scheduler.close(drain))."""
+    outstanding futures resolve with results, scheduler.close(drain)).
+
+    `max_body_bytes` / `max_lane_cells` are the pre-scheduling request
+    size limits (413 / 422); `recorder` (a loadgen.trace.TraceRecorder)
+    captures accepted /solve bodies into a replayable scenario trace;
+    `server_timing=False` suppresses the Server-Timing response header
+    (ops escape hatch, and the A/B arm bench.py's loadgen observer-
+    overhead measurement compares against)."""
 
     def __init__(self, engine, batcher, metrics, default_kernel: str,
-                 request_timeout: float = 600.0):
+                 request_timeout: float = 600.0,
+                 max_body_bytes: Optional[int] = None,
+                 max_lane_cells: Optional[int] = None,
+                 recorder=None, server_timing: bool = True):
         self.engine = engine
         self.batcher = batcher
         self.metrics = metrics
         self.default_kernel = default_kernel
         self.request_timeout = request_timeout
+        self.max_body_bytes = max_body_bytes
+        self.max_lane_cells = max_lane_cells
+        self.recorder = recorder
+        self.server_timing = server_timing
         self.started = time.time()
         self.draining = False
 
@@ -291,14 +342,19 @@ class _Handler(BaseHTTPRequestHandler):
     def state(self) -> ServerState:
         return self.server.wavetpu_state
 
-    def _send(self, code: int, payload: dict) -> None:
-        self._send_text(code, json.dumps(payload), "application/json")
+    def _send(self, code: int, payload: dict,
+              headers: Optional[dict] = None) -> None:
+        self._send_text(code, json.dumps(payload), "application/json",
+                        headers)
 
-    def _send_text(self, code: int, text: str, content_type: str) -> None:
+    def _send_text(self, code: int, text: str, content_type: str,
+                   headers: Optional[dict] = None) -> None:
         body = text.encode()
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -327,11 +383,18 @@ class _Handler(BaseHTTPRequestHandler):
             )
             if wants_text:
                 # Prometheus text exposition - one consistent registry
-                # cut (scrape config: docs/observability.md).
+                # cut (scrape config: docs/observability.md).  An
+                # openmetrics Accept additionally gets request-id
+                # EXEMPLARS on the latency histogram buckets (+ # EOF).
+                openmetrics = "openmetrics" in accept
                 self._send_text(
                     200,
-                    self.state.metrics.registry.render_prometheus(),
-                    "text/plain; version=0.0.4; charset=utf-8",
+                    self.state.metrics.registry.render_prometheus(
+                        openmetrics=openmetrics
+                    ),
+                    "application/openmetrics-text; version=1.0.0; "
+                    "charset=utf-8" if openmetrics
+                    else "text/plain; version=0.0.4; charset=utf-8",
                 )
                 return
             snap = self.state.metrics.snapshot()
@@ -347,12 +410,17 @@ class _Handler(BaseHTTPRequestHandler):
         # One `serve.request` span per request: its wall time is the
         # end-to-end latency; the scheduler-thread `serve.batch` span
         # that carried it joins on the shared request_id attribute
-        # (trace-report --request ID stitches the two).
-        rid = tracing.new_id()
+        # (trace-report --request ID stitches the two).  A caller-
+        # supplied X-Request-Id (the loadgen minted one) becomes THE id
+        # - so the client-side report and the server-side trace agree
+        # on the join key without any translation table.
+        rid = sanitize_request_id(self.headers.get("X-Request-Id"))
+        rid = rid or tracing.new_id()
         span = tracing.begin_span("serve.request", request_id=rid)
         code = None
+        headers: dict = {}
         try:
-            code, payload = self._handle_solve(rid)
+            code, payload, headers = self._handle_solve(rid)
         finally:
             # An unexpected handler exception must not leak the open
             # span (it would poison this thread's parent stack and
@@ -360,9 +428,11 @@ class _Handler(BaseHTTPRequestHandler):
             tracing.end_span(
                 span, status="exception" if code is None else code
             )
-        self._send(code, payload)
+        if rid:
+            headers.setdefault("X-Request-Id", rid)
+        self._send(code, payload, headers)
 
-    def _handle_solve(self, rid) -> Tuple[int, dict]:
+    def _handle_solve(self, rid) -> Tuple[int, dict, dict]:
         from wavetpu.serve.scheduler import QueueFullError
 
         st = self.state
@@ -371,15 +441,57 @@ class _Handler(BaseHTTPRequestHandler):
             return 503, {
                 "status": "error",
                 "error": "server draining (shutting down)",
-            }
+            }, {}
         t0 = time.monotonic()
         try:
-            length = int(self.headers.get("Content-Length", "0"))
+            length = int(self.headers.get("Content-Length", "0") or 0)
+            if length < 0:
+                # A negative length would turn rfile.read(length) into
+                # read-to-EOF and pin this handler thread forever.
+                raise ValueError(length)
+        except (TypeError, ValueError):
+            # A malformed Content-Length is a 400 like any other bad
+            # field, not a dropped connection (or a hung thread).
+            st.metrics.observe_response(False)
+            return 400, {
+                "status": "error",
+                "error": "malformed Content-Length header",
+            }, {}
+        if st.max_body_bytes is not None and length > st.max_body_bytes:
+            # Refused before the body is even read: an oversized upload
+            # must not be buffered just to be thrown away.
+            st.metrics.observe_limit_rejected("body_bytes")
+            st.metrics.observe_response(False)
+            return 413, {
+                "status": "error",
+                "error": (
+                    f"request body {length} bytes exceeds "
+                    f"--max-body-bytes {st.max_body_bytes}"
+                ),
+            }, {"Connection": "close"}
+        try:
             body = json.loads(self.rfile.read(length) or b"{}")
             req = parse_solve_request(body, st.default_kernel)
         except (ValueError, TypeError, json.JSONDecodeError) as e:
             st.metrics.observe_response(False)
-            return 400, {"status": "error", "error": str(e)}
+            return 400, {"status": "error", "error": str(e)}, {}
+        cells = req.problem.cells_per_step
+        if st.max_lane_cells is not None and cells > st.max_lane_cells:
+            # A parseable but oversized grid is rejected BEFORE it can
+            # occupy a scheduler slot or force a huge program compile.
+            st.metrics.observe_limit_rejected("lane_cells")
+            st.metrics.observe_response(False)
+            return 422, {
+                "status": "error",
+                "error": (
+                    f"lane grid (N+1)^3 = {cells} cells exceeds "
+                    f"--max-lane-cells {st.max_lane_cells}"
+                ),
+            }, {}
+        if st.recorder is not None:
+            # Accepted traffic only (post-validation, post-limits): the
+            # recorded trace replays cleanly instead of re-issuing junk.
+            st.recorder.record(body, request_id=rid)
         try:
             fut = st.batcher.submit(req, request_id=rid)
         except QueueFullError as e:
@@ -388,34 +500,45 @@ class _Handler(BaseHTTPRequestHandler):
             # (Sub-millisecond rejections stay out of the latency
             # reservoir - they would drag p50 to ~0 under overload.)
             st.metrics.observe_response(False)
-            return 429, {"status": "error", "error": str(e)}
+            return 429, {"status": "error", "error": str(e)}, {}
         except Exception as e:
             # A closed batcher ("batcher is closed" during shutdown)
             # gets its 500 JSON, not a connection reset - the
             # historical handler's contract.
             st.metrics.observe_response(False)
-            return 500, {"status": "error", "error": str(e)}
+            return 500, {"status": "error", "error": str(e)}, {}
         try:
             lane_result, lane_error, batch_info = fut.result(
                 st.request_timeout
             )
         except Exception as e:
             st.metrics.observe_response(False)
-            return 500, {"status": "error", "error": str(e)}
+            return 500, {"status": "error", "error": str(e)}, {}
         finally:
-            st.metrics.observe_latency(time.monotonic() - t0)
+            st.metrics.observe_latency(time.monotonic() - t0,
+                                       request_id=rid)
+        headers = {}
+        timing = batch_info.get("timing")
+        if st.server_timing and timing is not None:
+            headers["Server-Timing"] = server_timing_header(
+                timing, time.monotonic() - t0
+            )
         if lane_error is not None:
             st.metrics.observe_response(False)
             return 422, {
                 "status": "error",
                 "error": lane_error,
                 "batch": batch_info,
-            }
+            }, headers
         errors_computed = (
             st.engine.compute_errors and req.lane.c2tau2_field is None
         )
         st.metrics.observe_response(True)
-        return 200, _ok_payload(lane_result, batch_info, errors_computed)
+        return (
+            200,
+            _ok_payload(lane_result, batch_info, errors_computed),
+            headers,
+        )
 
 
 def build_server(
@@ -432,6 +555,10 @@ def build_server(
     interpret: Optional[bool] = None,
     length_bucket_steps: Optional[int] = None,
     max_queue: Optional[int] = None,
+    max_body_bytes: Optional[int] = None,
+    max_lane_cells: Optional[int] = None,
+    record_trace: Optional[str] = None,
+    server_timing: bool = True,
 ) -> Tuple[ThreadingHTTPServer, ServerState]:
     """Assemble engine + batcher + HTTP server (port 0 = ephemeral; the
     bound port is `httpd.server_address[1]`).  Returned httpd is not yet
@@ -439,8 +566,11 @@ def build_server(
     thread (tests do).  `length_bucket_steps` turns on stop-length
     bucketing in the scheduler (masked-lane FLOP control - see
     DynamicBatcher); `max_queue` bounds the request queue (full ->
-    429).  Engine and metrics share ONE MetricsRegistry so the
-    Prometheus exposition at /metrics is a single consistent cut."""
+    429); `max_body_bytes`/`max_lane_cells` refuse oversized requests
+    before scheduling (413/422); `record_trace` captures accepted
+    /solve traffic into a replayable loadgen scenario trace.  Engine
+    and metrics share ONE MetricsRegistry so the Prometheus exposition
+    at /metrics is a single consistent cut."""
     from wavetpu.obs.registry import MetricsRegistry
     from wavetpu.serve.engine import ServeEngine
     from wavetpu.serve.scheduler import DynamicBatcher, ServeMetrics
@@ -456,9 +586,16 @@ def build_server(
         engine, metrics=metrics, max_batch=max_batch, max_wait=max_wait,
         length_bucket_steps=length_bucket_steps, max_queue=max_queue,
     )
+    recorder = None
+    if record_trace is not None:
+        from wavetpu.loadgen.trace import TraceRecorder
+
+        recorder = TraceRecorder(record_trace)
     httpd = ThreadingHTTPServer((host, port), _Handler)
     httpd.wavetpu_state = ServerState(
-        engine, batcher, metrics, default_kernel
+        engine, batcher, metrics, default_kernel,
+        max_body_bytes=max_body_bytes, max_lane_cells=max_lane_cells,
+        recorder=recorder, server_timing=server_timing,
     )
     return httpd, httpd.wavetpu_state
 
@@ -494,6 +631,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         max_queue = (
             int(flags["max-queue"]) if "max-queue" in flags else None
         )
+        max_body_bytes = (
+            int(flags["max-body-bytes"])
+            if "max-body-bytes" in flags else None
+        )
+        max_lane_cells = (
+            int(flags["max-lane-cells"])
+            if "max-lane-cells" in flags else None
+        )
         max_amp = float(flags["max-amp"]) if "max-amp" in flags else None
         kernel = flags.get("kernel", "auto")
         if kernel not in ("auto", "roll", "pallas"):
@@ -524,8 +669,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         compute_errors="no-errors" not in flags,
         watchdog="no-watchdog" not in flags, max_amp=max_amp,
         default_kernel=kernel, length_bucket_steps=length_bucket_steps,
-        max_queue=max_queue,
+        max_queue=max_queue, max_body_bytes=max_body_bytes,
+        max_lane_cells=max_lane_cells,
+        record_trace=flags.get("record-trace"),
+        server_timing="no-server-timing" not in flags,
     )
+    if state.recorder is not None:
+        print(f"recording accepted /solve traffic: {flags['record-trace']}")
     telemetry = None
     serving = False
     try:
@@ -580,6 +730,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         state.batcher.close(timeout=120.0 if serving else 5.0,
                             drain=serving)
         httpd.server_close()
+        if state.recorder is not None:
+            state.recorder.close()
         if telemetry is not None:
             telemetry.stop()
     print("wavetpu serve: shut down cleanly (drained)")

@@ -195,10 +195,13 @@ class ServeEngine:
         dtype_name: str, with_field: bool, batch: int,
         mesh: Optional[Tuple[int, int, int]] = None,
     ):
-        """`program()` plus whether THIS call compiled - (prog, missed).
-        The bool is what warm-vs-cold execute attribution keys on;
-        diffing the shared `misses` counter instead would race with a
-        concurrent warmup taking a miss on a different key."""
+        """`program()` plus THIS call's compile attribution - (prog,
+        missed, compile_seconds).  The bool is what warm-vs-cold execute
+        attribution keys on; diffing the shared `misses` counter instead
+        would race with a concurrent warmup taking a miss on a
+        different key.  `compile_seconds` is 0.0 on a hit or fallback
+        and the measured build+compile wall time on a miss - the
+        `compile` component of the response's Server-Timing header."""
         compute_errors = self.compute_errors and not with_field
         if mesh is not None:
             if scheme != "standard":
@@ -217,7 +220,7 @@ class ServeEngine:
                 self.fallbacks.setdefault(
                     f"mesh:{tuple(mesh)}:{path}", why
                 )
-                return None, False
+                return None, False, 0.0
         else:
             ok, why = ensemble.vmap_capability(
                 path, k=k, interpret=self.interpret,
@@ -225,7 +228,7 @@ class ServeEngine:
             )
             if not ok:
                 self.fallbacks.setdefault(f"{scheme}:{path}", why)
-                return None, False
+                return None, False, 0.0
         key = ProgramKey.for_batch(
             problem, scheme, path, k, dtype_name, with_field,
             compute_errors, batch, mesh,
@@ -235,7 +238,7 @@ class ServeEngine:
             if prog is not None:
                 self._programs.move_to_end(key)
                 self._c_cache.inc(event="hit")
-                return prog, False
+                return prog, False, 0.0
             self._c_cache.inc(event="miss")
         # Build + compile OUTSIDE the lock (XLA compiles can take
         # seconds; warmup from another thread must not serialize on it).
@@ -258,14 +261,15 @@ class ServeEngine:
                     with_field=with_field, scheme=scheme,
                 )
             prog.compile()
-        self._h_compile.observe(time.perf_counter() - t0)
+        compile_seconds = time.perf_counter() - t0
+        self._h_compile.observe(compile_seconds)
         with self._lock:
             self._programs[key] = prog
             self._programs.move_to_end(key)
             while len(self._programs) > self.max_programs:
                 self._programs.popitem(last=False)
                 self._c_cache.inc(event="eviction")
-        return prog, True
+        return prog, True, compile_seconds
 
     def warmup(
         self, problem: Problem, scheme: str = "standard",
@@ -365,11 +369,16 @@ class ServeEngine:
         scheme: str = "standard", path: str = "roll", k: int = 4,
         dtype_name: str = "f32",
         mesh: Optional[Tuple[int, int, int]] = None,
+        timing: Optional[dict] = None,
     ) -> Tuple[ensemble.EnsembleResult, List[Optional[str]]]:
         """Pad to the bucket, run the cached program (or the recorded
         fallback), watchdog each lane; returns (EnsembleResult,
         per-lane health).  `mesh` routes the batch through the sharded x
-        batched composition."""
+        batched composition.  `timing`, when a dict is passed, is filled
+        in place with `compile_seconds` (this call's cache-miss compile,
+        0.0 warm) and `warm` ("true"/"false"/"fallback") - the
+        scheduler threads it into each response's Server-Timing header
+        without changing this method's return contract."""
         lanes = list(lanes)
         with_field = any(lane.c2tau2_field is not None for lane in lanes)
         compute_errors = self.compute_errors and not with_field
@@ -381,10 +390,16 @@ class ServeEngine:
         # per-lane compile behavior is jax-cache-dependent - its own
         # label value, so fallback outliers never pollute either the
         # warm or the cold batched population.
-        prog, missed = self._program(
+        prog, missed, compile_seconds = self._program(
             problem, scheme, path, k, dtype_name, with_field, bucket, mesh
         )
         warm = prog is not None and not missed
+        if timing is not None:
+            timing["compile_seconds"] = compile_seconds
+            timing["warm"] = (
+                "fallback" if prog is None
+                else "true" if warm else "false"
+            )
         with tracing.span(
             "serve.execute", scheme=scheme, path=path,
             occupancy=len(lanes), bucket=bucket, warm=warm,

@@ -120,6 +120,11 @@ class ServeMetrics:
             "wavetpu_serve_rejected_total",
             "requests rejected with 429 (bounded queue full)",
         )
+        self._limit_rejected = r.counter(
+            "wavetpu_serve_limit_rejected_total",
+            "requests refused by request-size limits before scheduling "
+            "(413 body bytes, 422 lane cells)", ("limit",),
+        )
         self._batches = r.counter(
             "wavetpu_serve_batches_total", "batches executed"
         )
@@ -173,6 +178,12 @@ class ServeMetrics:
     def observe_rejected(self) -> None:
         self._rejected.inc()
 
+    def observe_limit_rejected(self, limit: str) -> None:
+        """A request refused by `--max-body-bytes` (limit="body_bytes")
+        or `--max-lane-cells` (limit="lane_cells") before it ever
+        touched the queue."""
+        self._limit_rejected.inc(limit=limit)
+
     def observe_response(self, ok: bool) -> None:
         self._responses.inc(status="ok" if ok else "error")
 
@@ -182,7 +193,8 @@ class ServeMetrics:
     def observe_batch(self, occupancy: int, batched: bool,
                       cells: float, solve_seconds: float,
                       batch_size: Optional[int] = None,
-                      queue_waits: Sequence[float] = ()) -> None:
+                      queue_waits: Sequence[float] = (),
+                      request_ids: Sequence[Optional[str]] = ()) -> None:
         with self.registry.lock:
             self._batches.inc()
             self._occupancy.observe(occupancy)
@@ -195,13 +207,25 @@ class ServeMetrics:
             self._cells.inc(cells)
             self._solve_seconds.inc(solve_seconds)
             self._last_batch_ts.set(time.time())
-            for w in queue_waits:
-                self._queue_wait.observe(w)
+            for i, w in enumerate(queue_waits):
+                rid = request_ids[i] if i < len(request_ids) else None
+                self._queue_wait.observe(
+                    w,
+                    exemplar={"request_id": rid} if rid else None,
+                )
 
-    def observe_latency(self, seconds: float) -> None:
+    def observe_latency(self, seconds: float,
+                        request_id: Optional[str] = None) -> None:
+        """End-to-end request latency.  `request_id` becomes an
+        OpenMetrics exemplar on the bucket the observation lands in, so
+        a scraped p99 outlier bucket names the exact request to feed
+        `wavetpu trace-report --request`."""
         with self.registry.lock:
             self._latencies.append(seconds)
-            self._latency.observe(seconds)
+            self._latency.observe(
+                seconds,
+                exemplar={"request_id": request_id} if request_id else None,
+            )
 
     def _percentile(self, p: float) -> Optional[float]:
         if not self._latencies:
@@ -210,9 +234,18 @@ class ServeMetrics:
 
     def last_batch_age(self) -> Optional[float]:
         """Seconds since the last batch finished, or None before any
-        batch - the load balancer's idle-vs-wedged discriminator."""
-        ts = self._last_batch_ts.value()
-        return None if ts == 0 else max(0.0, time.time() - ts)
+        batch - the load balancer's idle-vs-wedged discriminator.
+
+        Keyed on the batches COUNTER, not the timestamp gauge: a gauge
+        still at its 0.0 default is indistinguishable from a genuine
+        t=0 timestamp, so "never executed a batch" (None) and "has
+        executed, currently idle" (a number, possibly 0.0) must be told
+        apart by whether any batch was ever counted."""
+        with self.registry.lock:
+            if self._batches.value() == 0:
+                return None
+            ts = self._last_batch_ts.value()
+        return max(0.0, time.time() - ts)
 
     def snapshot(self) -> dict:
         with self.registry.lock:
@@ -248,6 +281,7 @@ class ServeMetrics:
                 ),
                 "queue_depth": int(self._queue_depth.value()),
                 "rejected_total": int(self._rejected.value()),
+                "limit_rejected_total": int(self._limit_rejected.total()),
                 "padding_lanes_total": int(self._padding.value()),
                 "last_batch_age_seconds": (
                     None if age is None else round(age, 3)
@@ -507,12 +541,14 @@ class DynamicBatcher:
             k=req0.k, n=req0.problem.N,
             queue_wait_max_ms=round(max(waits) * 1e3, 3),
         )
+        timing: dict = {}
         try:
             result, lane_health = self.engine.solve(
                 req0.problem,
                 [item.request.lane for item in batch],
                 scheme=req0.scheme, path=req0.path, k=req0.k,
                 dtype_name=req0.dtype_name, mesh=req0.mesh_shape,
+                timing=timing,
             )
         except Exception as e:
             tracing.end_span(span, error=str(e))
@@ -520,6 +556,7 @@ class DynamicBatcher:
                 if not item.future.done():
                     item.future.set_exception(e)
             return
+        t_done = time.monotonic()
         tracing.end_span(
             span, batch_size=result.batch_size, batched=result.batched,
             padding_lanes=result.batch_size - result.n_lanes,
@@ -533,23 +570,47 @@ class DynamicBatcher:
             occupancy=result.n_lanes, batched=result.batched,
             cells=cells, solve_seconds=result.solve_seconds,
             batch_size=result.batch_size, queue_waits=waits,
+            request_ids=[item.request_id for item in batch],
         )
+        padding_lanes = result.batch_size - result.n_lanes
         batch_info = {
             "occupancy": result.n_lanes,
             "batch_size": result.batch_size,
             "batched": result.batched,
             "fallback_reason": result.fallback_reason,
             "path": result.path,
-            "padding_lanes": result.batch_size - result.n_lanes,
+            "padding_lanes": padding_lanes,
             "aggregate_gcells_per_s": round(
                 result.aggregate_gcells_per_second, 4
             ),
+            "warm": timing.get("warm"),
         }
+        # Per-request latency attribution (the Server-Timing header's
+        # source): queue = this request's submit-to-batch-formed wait,
+        # compile = the batch's cache-miss compile (0 warm), execute =
+        # everything after batch formation minus that compile (device
+        # march + watchdog + result plumbing), padding = the share of
+        # the batch's solve spent marching masked padding lanes -
+        # informational waste attribution, a subset of execute, NOT an
+        # additive wall-clock component.
+        compile_s = float(timing.get("compile_seconds", 0.0))
+        execute_s = max(0.0, t_done - t_formed - compile_s)
+        padding_s = (
+            result.solve_seconds * padding_lanes / result.batch_size
+            if result.batch_size else 0.0
+        )
         for i, item in enumerate(batch):
             # done() guard: a close() that timed out may have failed
             # this future already; a second set_ would raise
             # InvalidStateError inside the worker.
             if not item.future.done():
+                info = dict(batch_info)
+                info["timing"] = {
+                    "queue_s": waits[i],
+                    "compile_s": compile_s,
+                    "execute_s": execute_s,
+                    "padding_s": padding_s,
+                }
                 item.future.set_result(
-                    (result.results[i], lane_health[i], batch_info)
+                    (result.results[i], lane_health[i], info)
                 )
