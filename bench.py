@@ -444,6 +444,111 @@ def _loadgen_row(interp):
         return {"error": "failed; see stderr"}
 
 
+def _resilience_row(interp):
+    """The serving-resilience overhead proof: the headline serving
+    config replayed with the resilience layer LIVE - breaker admission
+    checks on every batch (default-on) plus a generous per-request
+    `deadline_ms` on every body (deadline bookkeeping in scheduler +
+    handler) - against a twin server with `breaker_threshold=None` and
+    no deadlines.  Both sides are warmed closed-loop replays of the
+    same trace over real HTTP; the delta is pure resilience-layer
+    host-side work (a breaker dict lookup + a monotonic comparison per
+    request), so the bar is <= 2% - same budget as the telemetry and
+    observer rows.  Also sanity-pins that nothing FIRED on the happy
+    path: zero deadline expiries, zero breaker opens."""
+    import threading
+    import traceback
+
+    from wavetpu.loadgen import report as lg_report
+    from wavetpu.loadgen import runner, trace
+    from wavetpu.serve.api import build_server
+
+    n, steps, kernel = (8, 6, "roll") if interp else (64, 20, "auto")
+    scenarios = trace.default_scenarios(n=n, timesteps=steps)
+    records = trace.generate(
+        "poisson", duration=3.0, qps=6.0, scenarios=scenarios, seed=17
+    )
+    # The "on" arm: every request carries a deadline it will never hit.
+    on_records = [
+        dict(r, body=dict(r["body"], deadline_ms=600000.0))
+        for r in records
+    ]
+
+    def serve(resilient):
+        httpd, state = build_server(
+            port=0, max_wait=0.02, default_kernel=kernel,
+            interpret=interp,
+            breaker_threshold=3 if resilient else None,
+        )
+        th = threading.Thread(target=httpd.serve_forever, daemon=True)
+        th.start()
+        return httpd, state, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    def run(base, recs, warmup):
+        res = runner.replay(base, recs, mode="closed", concurrency=4,
+                            warmup=warmup, timeout=1800)
+        return lg_report.build_report(res, target=base)
+
+    try:
+        httpd, state, base = serve(resilient=True)
+        try:
+            run(base, on_records, warmup=len(scenarios))
+            # Best-of-2 MEAN latency per arm: a single closed-loop p50
+            # over ~a dozen ms-scale requests swings tens of percent on
+            # a shared host; the min-of-means is the same transient
+            # suppression every other overhead row uses.
+            reps_on = [run(base, on_records, warmup=0)
+                       for _ in range(2)]
+            metrics = state.metrics.snapshot()
+            breaker = state.engine.breaker_stats()
+        finally:
+            httpd.shutdown()
+            state.batcher.close()
+            httpd.server_close()
+        httpd, state, base = serve(resilient=False)
+        try:
+            run(base, records, warmup=len(scenarios))
+            reps_off = [run(base, records, warmup=0)
+                        for _ in range(2)]
+        finally:
+            httpd.shutdown()
+            state.batcher.close()
+            httpd.server_close()
+        rep_on = min(reps_on, key=lambda r: r["latency_ms"]["mean_ms"])
+        mean_on = rep_on["latency_ms"]["mean_ms"]
+        mean_off = min(
+            r["latency_ms"]["mean_ms"] for r in reps_off
+        )
+        return {
+            "requests": rep_on["requests"],
+            "mean_ms": mean_on,
+            "p99_ms": rep_on["latency_ms"]["p99_ms"],
+            "mean_ms_plain": mean_off,
+            "mean_ms_runs": [r["latency_ms"]["mean_ms"]
+                             for r in reps_on],
+            "mean_ms_plain_runs": [r["latency_ms"]["mean_ms"]
+                                   for r in reps_off],
+            "error_rate": rep_on["error_rate"],
+            "deadline_expired": metrics["deadline_expired_total"],
+            "breaker_open": breaker.get("open"),
+            "resilience_overhead_pct_vs_plain": round(
+                100.0 * (mean_on - mean_off) / mean_off, 2
+            ) if mean_off else None,
+            "policy": "best_of_2",
+            "config": (
+                f"poisson mix {len(records)} reqs closed loop c=4 x2 "
+                f"replays/arm (min of means), N={n}/{steps} "
+                f"kernel={kernel}, warmed; breaker on + "
+                f"deadline_ms=600000 on every body vs --no-breaker/"
+                f"no-deadline twin; bar <= 2%"
+            ),
+        }
+    except Exception:
+        print("resilience sub-benchmark failed:", file=sys.stderr)
+        traceback.print_exc()
+        return {"error": "failed; see stderr"}
+
+
 def _occupancy_sweep(interp):
     """Batch-occupancy vs max_wait: the tail-latency/occupancy knob
     measured.  8 requests arrive ~10 ms apart at a max_batch=8 batcher;
@@ -802,6 +907,9 @@ def main() -> int:
     # HTTP stack, self-consistency regression gate, and the request-
     # path observer (Server-Timing + exemplars) overhead A/B.
     subs["loadgen"] = _loadgen_row(interp)
+    # Serving resilience: deadlines + breaker checks live vs a plain
+    # twin - the request-path resilience layer's <= 2% happy-path bar.
+    subs["resilience"] = _resilience_row(interp)
     line = {
         "metric": "gcell_updates_per_s",
         "value": head["gcells_per_s"],
@@ -870,6 +978,9 @@ def main() -> int:
         "loadgen_occupancy_mean": subs["loadgen"].get("occupancy_mean"),
         "loadgen_observer_overhead_pct": subs["loadgen"].get(
             "observer_overhead_pct_vs_no_server_timing"
+        ),
+        "resilience_overhead_pct": subs["resilience"].get(
+            "resilience_overhead_pct_vs_plain"
         ),
         "headline_summary": True,
     }

@@ -372,6 +372,114 @@ class TestReplayRoundTrip:
         assert rep["ok"] == rep["requests"]
 
 
+class TestSoakAndRetries:
+    """The resilience-round loadgen satellites: `--duration` soak mode
+    (loop the trace until a wall-clock budget elapses) and `--retries`
+    (the retrying WavetpuClient behind the runner)."""
+
+    def test_closed_loop_duration_soak_loops_the_trace(self, server):
+        base, _, _ = server
+        recs = trace.generate(
+            "uniform", 0.2, 10.0, scenarios=_mini_scenarios(), seed=3
+        )
+        res = runner.replay(base, recs, mode="closed", concurrency=2,
+                            duration=1.5, timeout=300)
+        assert res.wall_seconds >= 1.5
+        # the trace (2 requests) looped: more outcomes than records
+        assert len(res.outcomes) > len(recs)
+        assert all(o.status == 200 for o in res.outcomes)
+        rep = lg_report.build_report(res, target=base)
+        assert rep["requests"] == len(res.outcomes)
+        assert rep["attempts_total"] == rep["requests"]  # no retries
+
+    def test_open_loop_duration_extends_schedule(self):
+        recs = [
+            {"t": 0.0, "scenario": "a", "body": {"N": 8}},
+            {"t": 0.3, "scenario": "b", "body": {"N": 8}},
+        ]
+        ext = runner.extend_for_duration(recs, duration=1.0)
+        assert len(ext) > len(recs)
+        ts = [r["t"] for r in ext]
+        assert ts == sorted(ts)
+        assert len(ts) == len(set(ts))  # laps never collide
+        assert all(t < 1.0 for t in ts)
+        # speed compresses: a 2x speed fits twice the laps
+        assert len(runner.extend_for_duration(recs, 1.0, speed=2.0)) \
+            > len(ext)
+
+    def test_bad_duration_and_retries_rejected(self, server):
+        base, _, _ = server
+        recs = [{"t": 0.0, "scenario": "a", "body": {"N": 8}}]
+        with pytest.raises(ValueError, match="duration"):
+            runner.replay(base, recs, duration=0.0)
+        with pytest.raises(ValueError, match="retries"):
+            runner.replay(base, recs, retries=-1)
+
+    def test_retries_absorb_injected_connection_drops(self, tmp_path):
+        """The chaos half: a server that drops the first two
+        connections produces transport errors without retries and a
+        clean report WITH them - attempts accounting pins that the
+        retries actually happened."""
+        from wavetpu.run import faults
+
+        plan = faults.parse_serve_spec("serve-conn-drop:count=2")
+        httpd, state = build_server(
+            port=0, max_wait=0.02, default_kernel="roll",
+            interpret=True, fault_plan=plan,
+        )
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        recs = trace.generate(
+            "uniform", 0.5, 8.0, scenarios=_mini_scenarios(), seed=6
+        )
+        try:
+            res = runner.replay(base, recs, mode="closed",
+                                concurrency=1, retries=3, timeout=300)
+            assert all(o.status == 200 for o in res.outcomes)
+            # both drops were absorbed by retries (they may land on one
+            # logical request - its retry can be the second drop - or
+            # on two)
+            retried = [o for o in res.outcomes if o.attempts > 1]
+            assert sum(o.attempts - 1 for o in res.outcomes) == 2
+            assert 1 <= len(retried) <= 2
+            rep = lg_report.build_report(res, target=base)
+            assert rep["errors"] == 0
+            assert rep["retried_requests"] == len(retried)
+            assert rep["attempts_total"] == rep["requests"] + 2
+        finally:
+            httpd.shutdown()
+            state.batcher.close()
+            httpd.server_close()
+
+    def test_replay_cli_slo_gate_without_baseline(
+        self, server, tmp_path, capsys
+    ):
+        """`replay --error-budget 0` gates a baseline-less replay (the
+        nightly chaos smoke's zero-client-visible-errors check)."""
+        base, _, _ = server
+        path = str(tmp_path / "t.jsonl")
+        trace.save_scenario_trace(
+            path, trace.generate("uniform", 0.5, 6.0,
+                                 scenarios=_mini_scenarios(), seed=2)
+        )
+        assert loadgen_main([
+            "replay", path, "--target", base, "--mode", "closed",
+            "--concurrency", "2", "--timeout", "300",
+            "--retries", "2", "--error-budget", "0",
+        ]) == 0
+        out = capsys.readouterr().out
+        assert "-> PASS" in out and "retries:" in out
+        # a RELATIVE-only flag set does not gate a baseline-less
+        # replay (relative gates need a baseline; the strict default
+        # error budget must not kick in off an unrelated flag)
+        assert loadgen_main([
+            "replay", path, "--target", base, "--mode", "closed",
+            "--concurrency", "2", "--timeout", "300",
+            "--p99-regression-pct", "300",
+        ]) == 0
+        assert "-> " not in capsys.readouterr().out  # no gate ran
+
+
 class TestAcceptance:
     """ISSUE acceptance: self-consistency gate passes on a warmed
     server; an injected slowdown fails the p99 gate with exit != 0."""

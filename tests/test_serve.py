@@ -423,6 +423,48 @@ class TestDrain:
         assert resolved + errored == 4
         assert errored >= 1  # the tail was failed, not stranded
 
+    def test_drain_vs_submit_race_never_hangs(self):
+        """A request submitted CONCURRENTLY with close(drain=True) must
+        resolve - with a result or a fast shutdown error - never hang
+        to the client timeout.  Hammer the race: a spammer thread
+        submits as fast as it can while the main thread drains; every
+        future it got back must be done shortly after close returns."""
+        eng = _FakeEngine()
+        b = DynamicBatcher(eng, max_wait=0.01, max_batch=4)
+        p = Problem(N=8, timesteps=3)
+        futs = []
+        started = threading.Event()
+
+        def spam():
+            i = 0
+            while True:
+                try:
+                    futs.append(b.submit(_req(p, phase=1.0 + i)))
+                except RuntimeError:
+                    return  # batcher closed: the race window is over
+                i += 1
+                started.set()
+
+        th = threading.Thread(target=spam, daemon=True)
+        th.start()
+        assert started.wait(5)
+        b.close(timeout=30.0, drain=True)
+        th.join(10)
+        assert not th.is_alive()
+        assert futs  # the race actually happened
+        deadline = time.monotonic() + 10.0
+        resolved = errored = 0
+        for f in futs:
+            try:
+                f.result(max(0.0, deadline - time.monotonic()))
+                resolved += 1
+            except RuntimeError:
+                errored += 1
+        # every single future resolved fast - results for what the
+        # drain flushed, an immediate error for what raced past it
+        assert resolved + errored == len(futs)
+        assert resolved >= 1
+
     def test_close_without_drain_still_errors_stashed_leftovers(self):
         # The non-drain path keeps its contract: the in-flight batch
         # resolves, but a stashed different-key request fails fast
@@ -737,6 +779,71 @@ class TestHTTP:
         code, body = _get(base, "/healthz")
         assert code == 200
         assert body["status"] == "ok"
+
+    def test_healthz_liveness_vs_readiness(self, server):
+        """The readiness split: `status: ok` = the process serves HTTP;
+        `ready` = route traffic here - false while the warmup compile
+        runs or once draining is set, so a load balancer pulls the
+        replica BEFORE drain starts failing requests.  The loadgen
+        preflight refuses a not-ready target the same way."""
+        from wavetpu.loadgen import runner as lg_runner
+
+        base, state = server
+        code, body = _get(base, "/healthz")
+        assert code == 200
+        assert body["ready"] is True and body["warming"] is False
+        state.warming = True
+        try:
+            code, body = _get(base, "/healthz")
+            assert body["status"] == "ok"  # alive...
+            assert body["ready"] is False  # ...but do not route yet
+            with pytest.raises(lg_runner.PreflightError,
+                               match="not ready"):
+                lg_runner.preflight(base)
+        finally:
+            state.warming = False
+        state.draining = True
+        try:
+            code, body = _get(base, "/healthz")
+            assert body["ready"] is False and body["draining"] is True
+        finally:
+            state.draining = False
+        assert _get(base, "/healthz")[1]["ready"] is True
+
+    def test_429_and_503_carry_retry_after(self):
+        httpd, state = build_server(
+            port=0, max_wait=0.1, default_kernel="roll",
+            interpret=True, max_queue=0,
+        )
+        thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+        thread.start()
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            code, body, headers = _post_full(
+                base, {"N": 8, "timesteps": 4}
+            )
+            assert code == 429
+            assert headers.get("Retry-After") is not None
+            assert body["retriable"] is True
+            state.draining = True
+            code, body, headers = _post_full(
+                base, {"N": 8, "timesteps": 4}
+            )
+            assert code == 503
+            assert headers.get("Retry-After") is not None
+            assert body["retriable"] is True
+        finally:
+            state.draining = False
+            httpd.shutdown()
+            state.batcher.close()
+            httpd.server_close()
+
+    def test_metrics_json_carries_breaker_block(self, server):
+        base, _ = server
+        code, snap = _get(base, "/metrics")
+        assert code == 200
+        assert snap["breaker"]["enabled"] is True
+        assert snap["breaker"]["open"] == 0
 
     def test_healthz_idle_vs_wedged_fields(self, server):
         # The load-balancer discriminator fields: uptime, draining, and
@@ -1132,25 +1239,34 @@ class TestCLI:
 
     def test_serve_main_crash_stops_telemetry(self, tmp_path,
                                               monkeypatch, capsys):
-        """A crash between server build and serve start (warmup compile
-        failure here) must not leak the heartbeat daemon or leave the
-        process tracer bound for an in-process caller."""
+        """A crash after telemetry start but before/at serve (an
+        accept-loop failure injected here; --warmup now compiles in the
+        background and records failures instead of crashing main) must
+        not leak the heartbeat daemon or leave the process tracer bound
+        for an in-process caller."""
+        from http.server import ThreadingHTTPServer
+
         from wavetpu.obs import tracing
         from wavetpu.serve.api import main
 
         def boom(self, *a, **kw):
-            raise RuntimeError("injected warmup failure")
+            raise RuntimeError("injected accept-loop failure")
 
-        monkeypatch.setattr(ServeEngine, "warmup", boom)
+        monkeypatch.setattr(ThreadingHTTPServer, "serve_forever", boom)
         with pytest.raises(RuntimeError, match="injected"):
             main([
                 "--port", "0", "--kernel", "roll",
-                "--warmup", "8,4",
                 "--telemetry-dir", str(tmp_path / "tel"),
             ])
         assert not tracing.enabled()
         # the final heartbeat landed on the way out
         assert (tmp_path / "tel" / "heartbeat.jsonl").exists()
+
+    def test_serve_rejects_malformed_breaker_flags(self, capsys):
+        from wavetpu.serve.api import main
+
+        assert main(["--breaker-threshold", "x"]) == 2
+        assert main(["--breaker-cooldown-s", "y"]) == 2
 
     def test_program_key_shape(self):
         p = Problem(N=8, timesteps=3)

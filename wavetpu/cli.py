@@ -159,7 +159,11 @@ Non-zero supervised exits print `resumable checkpoint: PATH`.
 
 Subcommands: `wavetpu serve [...]` starts the batched-inference HTTP
 front end (wavetpu/serve/api.py, also installed as `wavetpu-serve`;
-endpoint contract in docs/serving.md).  `wavetpu trace-report
+endpoint contract in docs/serving.md; request-path resilience -
+deadlines, Retry-After, circuit breaker, worker supervision, chaos
+injection via WAVETPU_FAULT serve-* specs - in docs/robustness.md,
+with `wavetpu.client.WavetpuClient` as the retrying client half).
+`wavetpu trace-report
 TRACE.jsonl [--kind K] [--request ID]` summarizes a --telemetry-dir
 span trace (per-kind count/total/p50/p95; critical-path view of one
 request - wavetpu/obs/report.py; rotated segment sets are read whole).
@@ -168,7 +172,9 @@ request - wavetpu/obs/report.py; rotated segment sets are read whole).
 scenario JSONL traces, replay them open-/closed-loop against a live
 `wavetpu serve`, emit loadgen_report.json with per-tier p50/p95/p99 +
 occupancy + Server-Timing attribution, and diff two reports as a
-perf-regression gate (exit 1 on SLO violation).  `wavetpu --version`
+perf-regression gate (exit 1 on SLO violation); `replay --retries N`
+drives the retrying client (chaos drills), `--duration S` soaks a
+looped trace against a wall-clock budget.  `wavetpu --version`
 prints the package version (both entry points accept it).
 """
 

@@ -1,0 +1,251 @@
+"""`WavetpuClient` - the retrying HTTP client for `wavetpu serve`.
+
+The server side of the resilience contract (serve/api.py) promises
+typed, retriable failures: 429 + Retry-After under backpressure, 503 +
+Retry-After for a draining replica / a circuit-broken program / a
+crashed-and-restarted scheduler worker, 504 for an expired deadline.
+This client is the matching half:
+
+ * **Jittered exponential backoff** on retriable outcomes (transport
+   errors, 429, 500, 503), HONORING a `Retry-After` header when the
+   server sends one - the server knows its cooldown better than any
+   client-side curve.
+ * **Per-request deadlines**: `deadline_s` is one budget across ALL
+   attempts; each attempt forwards the remaining budget as
+   `deadline_ms` so the server sheds work this client has already given
+   up on, and retrying stops the moment the budget is gone.
+ * **Request-id reuse**: every attempt of one logical request carries
+   the SAME `X-Request-Id`, so `wavetpu trace-report --request ID`
+   against the server's telemetry shows the whole retry chain as one
+   story, not N unrelated requests.
+
+`solve()` returns a `SolveOutcome` (it does not raise on HTTP errors -
+the status/error fields are the result; a load generator must count
+failures, not crash on them).  Pure stdlib, never imports jax - safe
+for load-generation hosts with no accelerator stack (same discipline as
+loadgen/runner.py, which adopts this client behind `--retries`).
+
+    from wavetpu.client import WavetpuClient
+
+    client = WavetpuClient("http://localhost:8077", retries=3,
+                           deadline_s=30.0)
+    out = client.solve({"N": 64, "timesteps": 100})
+    if out.ok:
+        print(out.payload["report"]["gcells_per_second"])
+    else:
+        print(out.status, out.error, f"after {out.attempts} attempts")
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, List, Optional
+
+# Outcomes worth a retry: transport failure (status 0), backpressure
+# (429), engine failure (500 - the batch died, a retry lands in a fresh
+# batch), and retriable unavailability (503: draining, quarantined
+# program, restarted worker).  400/404/413/422 are THIS request's fault
+# and retrying cannot fix them; 504 means the deadline is already gone.
+RETRIABLE_STATUSES = frozenset((0, 429, 500, 503))
+
+
+@dataclasses.dataclass
+class SolveOutcome:
+    """One logical request's final result plus its retry history."""
+
+    status: int                    # final HTTP status; 0 = transport
+    payload: Optional[dict]        # parsed JSON body (None unparsable)
+    headers: Dict[str, str]        # final attempt's response headers
+    attempts: int                  # total attempts made (>= 1)
+    retries: List[dict]            # per-retry {status, delay_s, error}
+    latency_s: float               # wall across ALL attempts + backoff
+    request_id: str                # the id EVERY attempt carried
+    error: Optional[str] = None    # final error string (None on 200)
+
+    @property
+    def ok(self) -> bool:
+        return self.status == 200
+
+    @property
+    def server_timing(self) -> Optional[str]:
+        return self.headers.get("Server-Timing")
+
+
+def parse_retry_after(headers: Dict[str, str]) -> Optional[float]:
+    """Seconds from a `Retry-After` header (delta-seconds form only -
+    the server emits integers; HTTP-date is a proxy exotic we skip).
+    None when absent or unparseable."""
+    raw = headers.get("Retry-After")
+    if raw is None:
+        return None
+    try:
+        return max(0.0, float(raw))
+    except (TypeError, ValueError):
+        return None
+
+
+class WavetpuClient:
+    """Thread-safe-enough stdlib client (urllib per call, a lock-free
+    counter for minted ids is the only shared state - worst case two
+    threads mint the same id, which only merges two trace views).
+
+    `retries` is the RETRY budget (total attempts = retries + 1);
+    `deadline_s` the default per-request budget (None = unbounded);
+    `backoff_base_s`/`backoff_max_s` shape the jittered exponential
+    curve `min(max, base * 2^attempt) * uniform(0.5, 1.0)`.  `rng` and
+    `sleep` are injectable for deterministic tests."""
+
+    def __init__(
+        self,
+        base_url: str,
+        retries: int = 2,
+        timeout: float = 120.0,
+        deadline_s: Optional[float] = None,
+        backoff_base_s: float = 0.05,
+        backoff_max_s: float = 2.0,
+        rng: Optional[random.Random] = None,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        if retries < 0:
+            raise ValueError(f"retries must be >= 0, got {retries}")
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(
+                f"deadline_s must be > 0, got {deadline_s}"
+            )
+        self.base_url = base_url.rstrip("/")
+        self.retries = retries
+        self.timeout = timeout
+        self.deadline_s = deadline_s
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self._rng = rng if rng is not None else random.Random()
+        self._sleep = sleep
+        self._n = 0
+        self._tag = f"{int(time.time() * 1e3) & 0xFFFFFFFF:x}"
+
+    def _mint(self) -> str:
+        self._n += 1
+        return f"cl-{self._tag}-{self._n}"
+
+    # ---- transport ----
+
+    def _attempt(self, body: dict, rid: str, timeout: float):
+        """One POST /solve: (status, payload, headers, error)."""
+        req = urllib.request.Request(
+            self.base_url + "/solve", data=json.dumps(body).encode(),
+            headers={
+                "Content-Type": "application/json",
+                "X-Request-Id": rid,
+            },
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                raw = r.read()
+                status, headers = r.status, dict(r.headers)
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            status, headers = e.code, dict(e.headers)
+        except (OSError, urllib.error.URLError) as e:
+            return 0, None, {}, str(e)
+        try:
+            payload = json.loads(raw or b"{}")
+        except (ValueError, TypeError):
+            payload = None
+        error = None
+        if status != 200:
+            error = (payload or {}).get("error") or f"HTTP {status}"
+        return status, payload, headers, error
+
+    def healthz(self, timeout: float = 10.0) -> dict:
+        with urllib.request.urlopen(
+            self.base_url + "/healthz", timeout=timeout
+        ) as r:
+            return json.loads(r.read())
+
+    # ---- the retry loop ----
+
+    def solve(
+        self,
+        body: dict,
+        request_id: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+        retries: Optional[int] = None,
+        timeout: Optional[float] = None,
+    ) -> SolveOutcome:
+        """POST /solve with retry/backoff/deadline per the class doc.
+        The per-call kwargs override the client defaults; `request_id`
+        (else a minted `cl-*` id) rides EVERY attempt."""
+        retries = self.retries if retries is None else retries
+        deadline_s = (
+            self.deadline_s if deadline_s is None else deadline_s
+        )
+        timeout = self.timeout if timeout is None else timeout
+        rid = request_id or self._mint()
+        t0 = time.monotonic()
+        deadline = None if deadline_s is None else t0 + deadline_s
+        retried: List[dict] = []
+        attempt = 0
+        status, payload, headers, error = 0, None, {}, "not attempted"
+        while True:
+            remaining = (
+                None if deadline is None else deadline - time.monotonic()
+            )
+            if remaining is not None and remaining <= 0:
+                error = (
+                    f"client deadline {deadline_s:g}s exhausted after "
+                    f"{attempt} attempt(s); last: {error}"
+                )
+                break
+            send_body = body
+            if remaining is not None and "deadline_ms" not in body:
+                # Forward the REMAINING budget so the server sheds work
+                # this client will no longer read.
+                send_body = dict(
+                    body, deadline_ms=round(remaining * 1e3, 3)
+                )
+            att_timeout = (
+                timeout if remaining is None
+                else min(timeout, remaining + 0.25)
+            )
+            attempt += 1
+            status, payload, headers, error = self._attempt(
+                send_body, rid, att_timeout
+            )
+            if (
+                status == 200
+                or status not in RETRIABLE_STATUSES
+                or attempt > retries
+            ):
+                break
+            delay = parse_retry_after(headers)
+            if delay is None:
+                delay = min(
+                    self.backoff_max_s,
+                    self.backoff_base_s * (2 ** (attempt - 1)),
+                ) * (0.5 + 0.5 * self._rng.random())
+            if deadline is not None:
+                budget = deadline - time.monotonic()
+                if delay >= budget:
+                    error = (
+                        f"client deadline {deadline_s:g}s would expire "
+                        f"during backoff ({delay:.3f}s) after {attempt} "
+                        f"attempt(s); last: {error}"
+                    )
+                    break
+            retried.append({
+                "status": status,
+                "delay_s": round(delay, 4),
+                "error": error,
+            })
+            self._sleep(delay)
+        return SolveOutcome(
+            status=status, payload=payload, headers=headers,
+            attempts=attempt, retries=retried,
+            latency_s=time.monotonic() - t0, request_id=rid,
+            error=error if status != 200 else None,
+        )

@@ -5,11 +5,20 @@
         [--pallas] [--distinct D]
     wavetpu loadgen replay TRACE.jsonl --target URL [--mode open|closed]
         [--concurrency C] [--speed X] [--warmup W] [--timeout S]
+        [--retries N] [--duration SECONDS]
         [--out REPORT.json] [--no-preflight]
         [--baseline OLD.json] [SLO flags]
     wavetpu loadgen gate REPORT.json --baseline OLD.json [SLO flags]
 
-SLO flags (gate + replay-with-baseline):
+`--retries N` sends every request through the retrying WavetpuClient
+(jittered backoff honoring Retry-After, request-id reuse across
+attempts - the chaos-drill client); `--duration S` is SOAK mode: loop
+the trace until the wall-clock budget elapses, reported as replay-
+window deltas like any run.
+
+SLO flags (gate + replay-with-baseline; the ABSOLUTE ones also gate a
+baseline-less replay when passed explicitly - the chaos smoke's
+"zero client-visible errors" check):
     --p99-budget-ms X          absolute p99 cap
     --error-budget F           allowed non-ok non-429 fraction (default 0)
     --reject-budget F          allowed 429 fraction
@@ -18,9 +27,9 @@ SLO flags (gate + replay-with-baseline):
 
 Exit codes: 0 pass / generated / replayed; 1 SLO violation (the
 regression gate failed); 2 usage, unreadable input, or preflight
-failure.  `replay` without `--baseline` just writes the report;
-`replay --baseline OLD.json` additionally diffs against it and exits 1
-on violation - the one-command perf-regression gate CI runs.
+failure.  `replay` without `--baseline` or SLO flags just writes the
+report; `replay --baseline OLD.json` additionally diffs against it and
+exits 1 on violation - the one-command perf-regression gate CI runs.
 """
 
 from __future__ import annotations
@@ -112,7 +121,8 @@ def _replay(argv: Sequence[str]) -> int:
         pos, flags = _split_flags(
             argv,
             known=("target", "mode", "concurrency", "speed", "warmup",
-                   "timeout", "out", "baseline", "no-preflight")
+                   "timeout", "out", "baseline", "no-preflight",
+                   "retries", "duration")
             + tuple(_SLO_FLAGS),
             valueless=("no-preflight",),
         )
@@ -125,6 +135,10 @@ def _replay(argv: Sequence[str]) -> int:
         speed = float(flags.get("speed", "1"))
         warmup = int(flags.get("warmup", "0"))
         timeout = float(flags.get("timeout", "120"))
+        retries = int(flags.get("retries", "0"))
+        duration = (
+            float(flags["duration"]) if "duration" in flags else None
+        )
         slo = _slo_from_flags(flags)
         records = trace.load_scenario_trace(pos[0])
     except ValueError as e:
@@ -136,6 +150,7 @@ def _replay(argv: Sequence[str]) -> int:
             flags["target"], records, mode=mode,
             concurrency=concurrency, speed=speed, warmup=warmup,
             timeout=timeout, skip_preflight="no-preflight" in flags,
+            retries=retries, duration=duration,
         )
     except runner.PreflightError as e:
         print(f"error: preflight failed: {e}", file=sys.stderr)
@@ -155,12 +170,32 @@ def _replay(argv: Sequence[str]) -> int:
         f"occupancy {occ}; cold compiles "
         f"{report['server']['cold_compiles']}"
     )
+    if retries:
+        print(
+            f"retries: {report['retried_requests']} of "
+            f"{report['requests']} requests needed retries "
+            f"({report['attempts_total']} attempts total)"
+        )
     if "out" in flags:
         with open(flags["out"], "w", encoding="utf-8") as f:
             json.dump(report, f, indent=1, sort_keys=True)
         print(f"report written: {flags['out']}")
     if "baseline" in flags:
         return _run_gate(report, flags["baseline"], slo)
+    absolute = {
+        k: v for k, v in slo.items()
+        if k in ("p99_budget_ms", "error_budget", "reject_budget")
+    }
+    if absolute:
+        # An explicitly-passed ABSOLUTE SLO gates even without a
+        # baseline (the chaos smoke's zero-client-visible-errors
+        # check).  A relative-only flag set does NOT - relative gates
+        # need a baseline, and triggering the strict default
+        # error_budget off an unrelated flag would fail runs nobody
+        # asked to gate.
+        violations = lg_report.gate(report, baseline=None, slo=absolute)
+        print(lg_report.format_gate(violations, report, None))
+        return 1 if violations else 0
     return 0
 
 

@@ -161,6 +161,12 @@ def build_report(result, trace_path: Optional[str] = None,
         "errors": errors,
         "reject_rate": round(rejected / n, 4) if n else None,
         "error_rate": round(errors / n, 4) if n else None,
+        # Retry accounting (the retrying client's absorption record):
+        # attempts_total == requests when --retries is off or nothing
+        # failed; retried_requests counts logical requests that needed
+        # more than one attempt to reach their final status.
+        "attempts_total": sum(o.attempts for o in outs),
+        "retried_requests": sum(1 for o in outs if o.attempts > 1),
         "requests_per_s": (
             round(n / result.wall_seconds, 3)
             if result.wall_seconds else None

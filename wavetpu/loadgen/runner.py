@@ -57,8 +57,10 @@ def _get(url: str, timeout: float, accept: Optional[str] = None):
 
 
 def preflight(base_url: str, timeout: float = 10.0) -> dict:
-    """Assert the target is alive and accepting BEFORE replay: /healthz
-    must answer 200 with status ok and draining false.  Returns the
+    """Assert the target is alive, READY, and accepting BEFORE replay:
+    /healthz must answer 200 with status ok, `ready` not false (false =
+    still warming or draining - a load balancer would not route there,
+    so neither does the loadgen), and draining false.  Returns the
     health payload (uptime, last_batch_age_seconds - null means the
     server has never executed a batch, i.e. replay starts cold)."""
     url = base_url.rstrip("/") + "/healthz"
@@ -69,6 +71,12 @@ def preflight(base_url: str, timeout: float = 10.0) -> dict:
         raise PreflightError(f"cannot reach {url}: {e}")
     if status != 200 or health.get("status") != "ok":
         raise PreflightError(f"{url} unhealthy: {health}")
+    if health.get("ready") is False:
+        raise PreflightError(
+            f"{url} not ready "
+            f"(warming={health.get('warming')}, "
+            f"draining={health.get('draining')})"
+        )
     if health.get("draining"):
         raise PreflightError(f"{url} is draining (shutting down)")
     return health
@@ -130,7 +138,9 @@ def parse_server_timing(header: Optional[str]) -> Dict[str, float]:
 
 @dataclasses.dataclass
 class RequestOutcome:
-    """One replayed request, client-side view + parsed Server-Timing."""
+    """One replayed request, client-side view + parsed Server-Timing.
+    `attempts` > 1 means the retrying client (`--retries`) absorbed
+    retriable failures before this final status."""
 
     index: int
     scenario: str
@@ -142,6 +152,7 @@ class RequestOutcome:
         default_factory=dict
     )
     error: Optional[str] = None
+    attempts: int = 1
 
 
 @dataclasses.dataclass
@@ -157,7 +168,23 @@ class ReplayResult:
 
 
 def _post_one(base_url: str, index: int, rec: dict, rid: str,
-              t_sent: float, timeout: float) -> RequestOutcome:
+              t_sent: float, timeout: float,
+              client=None) -> RequestOutcome:
+    if client is not None:
+        # The retrying path (`--retries`): wavetpu.client.WavetpuClient
+        # absorbs transport errors / 429 / 500 / 503 with jittered
+        # backoff honoring Retry-After; the SAME request id rides every
+        # attempt, so the report's join handles still resolve.
+        out = client.solve(rec["body"], request_id=rid)
+        return RequestOutcome(
+            index=index, scenario=rec.get("scenario", "?"),
+            request_id=rid, status=out.status,
+            latency_s=out.latency_s, t_sent=t_sent,
+            server_timing=parse_server_timing(
+                out.headers.get("Server-Timing")
+            ),
+            error=out.error, attempts=out.attempts,
+        )
     body = json.dumps(rec["body"]).encode()
     req = urllib.request.Request(
         base_url.rstrip("/") + "/solve", data=body,
@@ -193,6 +220,30 @@ def _mint_rid(run_tag: str, index: int) -> str:
     return f"lg-{run_tag}-{index}"
 
 
+def extend_for_duration(records: Sequence[dict], duration: float,
+                        speed: float = 1.0) -> List[dict]:
+    """The open-loop soak schedule: loop the trace (each lap offset by
+    the trace span plus one mean gap, so laps never collide on the same
+    timestamp) until the wall-clock budget `duration` is filled at
+    replay `speed`.  Always returns at least one record."""
+    records = list(records)
+    span = records[-1]["t"]
+    gap = (span / len(records)) if span > 0 else 0.01
+    lap_len = span + max(gap, 1e-3)
+    out: List[dict] = []
+    lap = 0
+    while (lap * lap_len) / speed < duration:
+        for rec in records:
+            t = rec["t"] + lap * lap_len
+            if t / speed >= duration:
+                break
+            out.append(dict(rec, t=t))
+        lap += 1
+    if not out:
+        out.append(dict(records[0], t=0.0))
+    return out
+
+
 def replay(
     base_url: str,
     records: Sequence[dict],
@@ -203,6 +254,8 @@ def replay(
     timeout: float = 120.0,
     run_tag: Optional[str] = None,
     skip_preflight: bool = False,
+    retries: int = 0,
+    duration: Optional[float] = None,
 ) -> ReplayResult:
     """Drive `records` at `base_url`; returns outcomes + the /metrics
     cuts bracketing the measured phase.  `warmup` > 0 first serves up
@@ -210,13 +263,23 @@ def replay(
     excluded from the measurement - so steady-state numbers are not
     first-compile numbers.  `speed` > 1 time-compresses an open-loop
     trace (a 300 s recorded trace replayed at speed=10 offers 10x the
-    QPS in 30 s)."""
+    QPS in 30 s).  `retries` > 0 sends every request through the
+    retrying `wavetpu.client.WavetpuClient` (jittered backoff honoring
+    Retry-After, request-id reuse - outcomes record `attempts`).
+    `duration` turns the replay into a SOAK: the trace loops until the
+    wall-clock budget elapses (open loop re-offsets each lap's
+    timestamps; closed loop cycles the records), still reported as
+    replay-window deltas like any run."""
     if mode not in ("open", "closed"):
         raise ValueError(f"mode must be open|closed, got {mode!r}")
     if concurrency < 1:
         raise ValueError(f"concurrency must be >= 1, got {concurrency}")
     if speed <= 0:
         raise ValueError(f"speed must be > 0, got {speed}")
+    if retries < 0:
+        raise ValueError(f"retries must be >= 0, got {retries}")
+    if duration is not None and duration <= 0:
+        raise ValueError(f"duration must be > 0, got {duration}")
     records = list(records)
     if not records:
         raise ValueError("empty trace")
@@ -226,6 +289,12 @@ def replay(
         # Unique enough across replays against one server; hex keeps it
         # inside the server's sanitized request-id alphabet.
         run_tag = f"{int(time.time() * 1e3) & 0xFFFFFFFF:x}"
+    client = None
+    if retries > 0:
+        from wavetpu.client import WavetpuClient
+
+        client = WavetpuClient(base_url, retries=retries,
+                               timeout=timeout)
 
     warmup_outcomes: List[RequestOutcome] = []
     if warmup > 0:
@@ -238,18 +307,62 @@ def replay(
             seen.add(tier)
             warmup_outcomes.append(_post_one(
                 base_url, wi, rec, _mint_rid(run_tag + "w", wi), 0.0,
-                timeout,
+                timeout, client,
             ))
             wi += 1
 
+    if duration is not None and mode == "open":
+        records = extend_for_duration(records, duration, speed)
+
     metrics_before = scrape_metrics(base_url)
-    outcomes: List[Optional[RequestOutcome]] = [None] * len(records)
     t_start = time.perf_counter()
+
+    if duration is not None and mode == "closed":
+        # Soak: `concurrency` workers cycle the trace until the budget
+        # elapses; outcomes accumulate (the request count is a result,
+        # not an input).
+        soak: List[RequestOutcome] = []
+        nxt = {"i": 0}
+        lock = threading.Lock()
+        stop_at = t_start + duration
+
+        def soak_worker():
+            while time.perf_counter() < stop_at:
+                with lock:
+                    i = nxt["i"]
+                    nxt["i"] = i + 1
+                out = _post_one(
+                    base_url, i, records[i % len(records)],
+                    _mint_rid(run_tag, i),
+                    time.perf_counter() - t_start, timeout, client,
+                )
+                with lock:
+                    soak.append(out)
+
+        threads = [
+            threading.Thread(target=soak_worker, daemon=True)
+            for _ in range(concurrency)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(duration + timeout + 30.0)
+        with lock:
+            done = sorted(soak, key=lambda o: o.index)
+        return ReplayResult(
+            outcomes=done, warmup_outcomes=warmup_outcomes,
+            metrics_before=metrics_before,
+            metrics_after=scrape_metrics(base_url),
+            wall_seconds=time.perf_counter() - t_start, mode=mode,
+            concurrency=concurrency, speed=speed,
+        )
+
+    outcomes: List[Optional[RequestOutcome]] = [None] * len(records)
 
     def fire(i: int, rec: dict) -> None:
         outcomes[i] = _post_one(
             base_url, i, rec, _mint_rid(run_tag, i),
-            time.perf_counter() - t_start, timeout,
+            time.perf_counter() - t_start, timeout, client,
         )
 
     if mode == "open":

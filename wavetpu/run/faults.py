@@ -19,13 +19,46 @@ chunk completes, BEFORE the health check and checkpoint save - exactly
 where a hardware glitch would land.  `hook_from_env` wires the same
 injectors to the `WAVETPU_FAULT` env var ("nan:STEP" | "preempt:STEP")
 so CLI-level tests can drill the full exit-code path of a live process.
+
+Since the serving-resilience round the SAME env var also ports the
+harness into `wavetpu serve`: semicolon-separated `serve-*` specs build
+a `ServeFaultPlan` (`serve_plan_from_env`) that the engine, scheduler,
+and HTTP layer consult at their seams -
+
+ * `serve-compile-fail[:SELECTOR,count=N]` - program build/compile for
+   matching ProgramKeys raises `InjectedFault` (drives the circuit
+   breaker and the retrying client);
+ * `serve-execute-nan[:SELECTOR,count=N]`  - a matching batch's final
+   state is poisoned with NaN AFTER the solve, proving the per-lane
+   watchdog 422s it;
+ * `serve-slow-batch:seconds=S[,SELECTOR]` - the worker sleeps S before
+   executing a matching batch (deadline/queue-growth drills);
+ * `serve-worker-crash[:after=N,count=K]`  - the scheduler worker
+   raises mid-batch (its supervisor must restart it and fail in-flight
+   futures with retriable 503s, never hang them);
+ * `serve-conn-drop[:count=N]`             - the HTTP handler closes
+   the connection without a response (client transport-retry drill).
+
+SELECTOR is `field=value` pairs matched against the batch's program
+identity (`n`, `timesteps`, `scheme`, `path`, `k`, `dtype`), so one
+tier can be poisoned while its batchmates keep serving.  Every firing
+is counted as `wavetpu_serve_fault_injections_total{kind=}` in the
+server's registry - an injection that fired silently would make a chaos
+drill unfalsifiable.
 """
 
 from __future__ import annotations
 
 import os
 import signal
-from typing import Optional
+import threading
+from typing import Dict, List, Optional
+
+
+class InjectedFault(RuntimeError):
+    """A deliberately-injected serve-path failure (compile/worker).  Its
+    type matters only to tests; the serve stack treats it like any other
+    compile/execute exception - that is the point."""
 
 
 # ---------------------------------------------------------------- on disk
@@ -128,17 +161,200 @@ def hook_from_env(env: Optional[dict] = None):
     """The CLI port of the harness: WAVETPU_FAULT="nan:STEP" or
     "preempt:STEP" returns the matching chunk hook (None when unset).
     Lets subprocess/CLI tests drill the watchdog-halt (exit 4) and
-    kill-and-resume (exit 3) paths without timing races."""
+    kill-and-resume (exit 3) paths without timing races.  `serve-*`
+    specs (the serve-path plan, possibly ';'-combined with a run-side
+    spec) are ignored here - they belong to `serve_plan_from_env`."""
     env = os.environ if env is None else env
     spec = env.get(ENV_FAULT)
     if not spec:
         return None
-    kind, _, at = spec.partition(":")
+    run_specs = [
+        part.strip() for part in spec.split(";")
+        if part.strip() and not part.strip().startswith("serve-")
+    ]
+    if not run_specs:
+        return None
+    if len(run_specs) > 1:
+        # One run-side fault per drill, as before - silently running
+        # only the first would make the second assertion vacuous.
+        raise ValueError(
+            f"{ENV_FAULT}: at most one run-side spec, got {run_specs}"
+        )
+    kind, _, at = run_specs[0].partition(":")
     step = int(at)
     if kind == "nan":
         return nan_at_step(step)
     if kind == "preempt":
         return preempt_at_step(step)
     raise ValueError(
-        f"{ENV_FAULT}={spec!r}: want 'nan:STEP' or 'preempt:STEP'"
+        f"{ENV_FAULT}={run_specs[0]!r}: want 'nan:STEP' or "
+        f"'preempt:STEP'"
     )
+
+
+# ------------------------------------------------------------ serve path
+
+
+SERVE_KINDS = ("compile-fail", "execute-nan", "slow-batch",
+               "worker-crash", "conn-drop")
+
+# Program-identity fields a selector may match on (ctx keys the serve
+# seams pass to `fire`).
+_SELECTOR_FIELDS = ("n", "timesteps", "scheme", "path", "k", "dtype")
+
+
+class ServeInjection:
+    """One armed serve-path injection: a kind, an optional program-
+    identity selector, and firing budgets (`after` eligible events are
+    skipped first; `count` bounds total fires, None = unlimited)."""
+
+    def __init__(self, kind: str, match: Optional[Dict[str, str]] = None,
+                 count: Optional[int] = None, after: int = 0,
+                 seconds: float = 0.0):
+        if kind not in SERVE_KINDS:
+            raise ValueError(
+                f"unknown serve fault kind {kind!r}; want one of "
+                f"{SERVE_KINDS}"
+            )
+        self.kind = kind
+        self.match = dict(match or {})
+        if kind == "conn-drop" and self.match:
+            # conn-drop fires before the body is parsed - there is no
+            # program identity to match, so a selector would silently
+            # never fire (the inverse of the counted-firings goal).
+            raise ValueError(
+                "serve-conn-drop takes no selector (it fires before "
+                f"the request is parsed); got {sorted(self.match)}"
+            )
+        for f in self.match:
+            if f not in _SELECTOR_FIELDS:
+                raise ValueError(
+                    f"serve-{kind}: unknown selector field {f!r}; want "
+                    f"one of {_SELECTOR_FIELDS}"
+                )
+        self.count = count
+        self.after = after
+        self.seconds = seconds
+        self.fired = 0
+
+    def matches(self, ctx: Dict) -> bool:
+        return all(
+            str(ctx.get(f)) == str(v) for f, v in self.match.items()
+        )
+
+    def snapshot(self) -> dict:
+        return {
+            "kind": self.kind,
+            "match": dict(self.match),
+            "fired": self.fired,
+            "remaining": self.count,
+            "after": self.after,
+            "seconds": self.seconds,
+        }
+
+
+class ServeFaultPlan:
+    """The serve stack's injection registry: engine, scheduler, and HTTP
+    layer call `fire(kind, **program_identity)` at their seams; the plan
+    decides (thread-safely, budget-counted) whether THIS event breaks.
+
+    One plan per server (build_server shares one object across all
+    seams) so `count=` budgets mean what they say.  `bind_registry`
+    attaches the `wavetpu_serve_fault_injections_total{kind=}` counter;
+    an unbound plan still fires (unit tests), it just counts privately.
+    """
+
+    def __init__(self, injections: List[ServeInjection] = ()):
+        self._inj = list(injections)
+        self._lock = threading.Lock()
+        self._counter = None
+
+    @property
+    def active(self) -> bool:
+        return bool(self._inj)
+
+    def bind_registry(self, registry) -> None:
+        self._counter = registry.counter(
+            "wavetpu_serve_fault_injections_total",
+            "chaos-harness injections fired on the serve path",
+            ("kind",),
+        )
+
+    def fire(self, kind: str, **ctx) -> Optional[ServeInjection]:
+        """The matching armed injection if this event fires (budgets
+        decremented, firing counted), else None."""
+        if not self._inj:
+            return None
+        with self._lock:
+            for inj in self._inj:
+                if inj.kind != kind or not inj.matches(ctx):
+                    continue
+                if inj.after > 0:
+                    inj.after -= 1
+                    continue
+                if inj.count is not None and inj.count <= 0:
+                    continue
+                if inj.count is not None:
+                    inj.count -= 1
+                inj.fired += 1
+                if self._counter is not None:
+                    self._counter.inc(kind=kind)
+                return inj
+        return None
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return [inj.snapshot() for inj in self._inj]
+
+
+def parse_serve_spec(spec: str) -> Optional[ServeFaultPlan]:
+    """Parse the `serve-*` halves of a WAVETPU_FAULT value into a plan
+    (None when the value carries no serve specs).  Grammar per spec:
+    `serve-KIND[:key=value,...]` with params `count`/`after`/`seconds`
+    and selector fields n/timesteps/scheme/path/k/dtype; specs are
+    ';'-separated and may mix with run-side `nan:`/`preempt:` specs."""
+    injections: List[ServeInjection] = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part or not part.startswith("serve-"):
+            continue
+        kind, _, params = part[len("serve-"):].partition(":")
+        match: Dict[str, str] = {}
+        count: Optional[int] = None
+        after = 0
+        seconds = 0.0
+        if params:
+            for kv in params.split(","):
+                kv = kv.strip()
+                if not kv:
+                    continue
+                k, sep, v = kv.partition("=")
+                if not sep:
+                    raise ValueError(
+                        f"{ENV_FAULT}: serve-{kind} wants key=value "
+                        f"params, got {kv!r}"
+                    )
+                if k == "count":
+                    count = int(v)
+                elif k == "after":
+                    after = int(v)
+                elif k == "seconds":
+                    seconds = float(v)
+                else:
+                    match[k] = v
+        injections.append(
+            ServeInjection(kind, match, count=count, after=after,
+                           seconds=seconds)
+        )
+    return ServeFaultPlan(injections) if injections else None
+
+
+def serve_plan_from_env(env: Optional[dict] = None
+                        ) -> Optional[ServeFaultPlan]:
+    """The serve stack's WAVETPU_FAULT port (None when unset or when the
+    value carries only run-side specs)."""
+    env = os.environ if env is None else env
+    spec = env.get(ENV_FAULT)
+    if not spec:
+        return None
+    return parse_serve_spec(spec)

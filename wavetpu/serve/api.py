@@ -23,9 +23,24 @@ Endpoints (contract in docs/serving.md):
                  --max-body-bytes refuses oversized bodies with 413 and
                  --max-lane-cells refuses oversized grids with 422,
                  both BEFORE scheduling (counted in /metrics).
-  GET /healthz   liveness AND wedge detection: {"status": "ok",
-                 "uptime_seconds", "draining", "last_batch_age_seconds"}
-                 - a load balancer distinguishes idle (no traffic, age
+                 Resilience contract (docs/robustness.md): a request
+                 may carry `deadline_ms` (JSON field, or the
+                 `X-Deadline-Ms` header, which wins) - a relative
+                 budget from server receipt; expired-in-queue work is
+                 dropped with 504 + queue attribution and the handler
+                 never outwaits the budget.  429 (queue full) and 503
+                 (draining / circuit-broken program / worker crash)
+                 carry `Retry-After` and `"retriable": true`; a
+                 ProgramKey with K consecutive compile/execute
+                 failures is quarantined by the engine's circuit
+                 breaker (--breaker-threshold/--breaker-cooldown-s/
+                 --no-breaker) while other tiers keep serving.
+  GET /healthz   liveness AND readiness: {"status": "ok", "ready",
+                 "uptime_seconds", "draining", "warming",
+                 "last_batch_age_seconds"} - `status` says the process
+                 serves HTTP, `ready` says ROUTE HERE (false while the
+                 --warmup compile runs or once draining is set); a
+                 load balancer distinguishes idle (no traffic, age
                  null/stale but draining false) from wedged; age is
                  null ONLY if no batch was ever executed.
   GET /metrics   request counts, batch occupancy, p50/p95 latency,
@@ -67,6 +82,7 @@ import json
 import sys
 import threading
 import time
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence, Tuple
 
@@ -80,6 +96,7 @@ _USAGE = (
     "[--max-body-bytes B] [--max-lane-cells C] "
     "[--kernel auto|roll|pallas] "
     "[--no-errors] [--max-amp X] [--no-watchdog] [--no-server-timing] "
+    "[--breaker-threshold K] [--breaker-cooldown-s S] [--no-breaker] "
     "[--warmup N,TIMESTEPS[,K]] [--platform NAME] "
     "[--telemetry-dir DIR] [--record-trace FILE.jsonl] [--version]"
 )
@@ -89,9 +106,11 @@ _KNOWN = (
     "max-programs", "length-bucket-steps", "max-queue",
     "max-body-bytes", "max-lane-cells", "kernel",
     "no-errors", "max-amp", "no-watchdog", "no-server-timing",
+    "breaker-threshold", "breaker-cooldown-s", "no-breaker",
     "warmup", "platform", "telemetry-dir", "record-trace", "version",
 )
-_VALUELESS = ("no-errors", "no-watchdog", "no-server-timing", "version")
+_VALUELESS = ("no-errors", "no-watchdog", "no-server-timing",
+              "no-breaker", "version")
 
 
 def _split_flags(argv: Sequence[str]) -> dict:
@@ -319,7 +338,8 @@ class ServerState:
                  request_timeout: float = 600.0,
                  max_body_bytes: Optional[int] = None,
                  max_lane_cells: Optional[int] = None,
-                 recorder=None, server_timing: bool = True):
+                 recorder=None, server_timing: bool = True,
+                 fault_plan=None):
         self.engine = engine
         self.batcher = batcher
         self.metrics = metrics
@@ -329,8 +349,15 @@ class ServerState:
         self.max_lane_cells = max_lane_cells
         self.recorder = recorder
         self.server_timing = server_timing
+        self.fault_plan = fault_plan
         self.started = time.time()
         self.draining = False
+        # Readiness: `warming` is True while the background --warmup
+        # compile runs; /healthz reports ready = not draining and not
+        # warming, so a load balancer routes to a replica only once its
+        # programs exist and pulls it BEFORE drain kills requests.
+        self.warming = False
+        self.warmup_error: Optional[str] = None
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -361,16 +388,28 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802 (stdlib contract)
         if self.path == "/healthz":
             age = self.state.metrics.last_batch_age()
-            self._send(200, {
+            # Liveness vs READINESS: "status: ok" = the process serves
+            # HTTP (liveness); "ready" = route traffic here (false while
+            # the warmup compile is still running, or once draining is
+            # set - so a load balancer stops routing BEFORE drain starts
+            # failing requests, not after).
+            payload = {
                 "status": "ok",
+                "ready": (
+                    not self.state.draining and not self.state.warming
+                ),
                 "uptime_seconds": round(
                     time.time() - self.state.started, 3
                 ),
                 "draining": self.state.draining,
+                "warming": self.state.warming,
                 "last_batch_age_seconds": (
                     None if age is None else round(age, 3)
                 ),
-            })
+            }
+            if self.state.warmup_error is not None:
+                payload["warmup_error"] = self.state.warmup_error
+            self._send(200, payload)
         elif self.path == "/metrics":
             accept = self.headers.get("Accept", "") or ""
             # A client that lists application/json at all (e.g. the
@@ -399,6 +438,7 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             snap = self.state.metrics.snapshot()
             snap["program_cache"] = self.state.engine.cache_stats()
+            snap["breaker"] = self.state.engine.breaker_stats()
             self._send(200, snap)
         else:
             self._send(404, {"status": "error", "error": "not found"})
@@ -406,6 +446,18 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:  # noqa: N802
         if self.path != "/solve":
             self._send(404, {"status": "error", "error": "not found"})
+            return
+        # Chaos seam: connection drop - close the socket with no
+        # response at all, the failure mode a crashed proxy or a
+        # severed network produces (the retrying client must absorb it
+        # as a transport error).
+        plan = self.state.fault_plan
+        if plan is not None and plan.active and plan.fire("conn-drop"):
+            self.close_connection = True
+            try:
+                self.connection.close()
+            except OSError:
+                pass
             return
         # One `serve.request` span per request: its wall time is the
         # end-to-end latency; the scheduler-thread `serve.batch` span
@@ -433,6 +485,11 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(code, payload, headers)
 
     def _handle_solve(self, rid) -> Tuple[int, dict, dict]:
+        from wavetpu.serve.resilience import (
+            DeadlineExceededError,
+            QuarantinedError,
+            WorkerCrashError,
+        )
         from wavetpu.serve.scheduler import QueueFullError
 
         st = self.state
@@ -441,7 +498,8 @@ class _Handler(BaseHTTPRequestHandler):
             return 503, {
                 "status": "error",
                 "error": "server draining (shutting down)",
-            }, {}
+                "retriable": True,
+            }, {"Retry-After": "2"}
         t0 = time.monotonic()
         try:
             length = int(self.headers.get("Content-Length", "0") or 0)
@@ -472,6 +530,21 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             body = json.loads(self.rfile.read(length) or b"{}")
             req = parse_solve_request(body, st.default_kernel)
+            # Deadline contract: `X-Deadline-Ms` header (proxy-settable,
+            # wins) or JSON `deadline_ms` - a RELATIVE budget in ms from
+            # server receipt.  None (the historical default) disables
+            # every deadline path bit-for-bit.
+            raw_dl = self.headers.get("X-Deadline-Ms")
+            if raw_dl is None:
+                raw_dl = body.get("deadline_ms")
+            deadline = deadline_ms = None
+            if raw_dl is not None:
+                deadline_ms = float(raw_dl)
+                if not deadline_ms > 0:
+                    raise ValueError(
+                        f"deadline_ms must be > 0, got {deadline_ms}"
+                    )
+                deadline = t0 + deadline_ms / 1e3
         except (ValueError, TypeError, json.JSONDecodeError) as e:
             st.metrics.observe_response(False)
             return 400, {"status": "error", "error": str(e)}, {}
@@ -493,24 +566,85 @@ class _Handler(BaseHTTPRequestHandler):
             # recorded trace replays cleanly instead of re-issuing junk.
             st.recorder.record(body, request_id=rid)
         try:
-            fut = st.batcher.submit(req, request_id=rid)
+            fut = st.batcher.submit(req, request_id=rid,
+                                    deadline=deadline)
         except QueueFullError as e:
             # Bounded-queue backpressure: shed load NOW instead of
-            # stacking latency the client will time out on anyway.
-            # (Sub-millisecond rejections stay out of the latency
-            # reservoir - they would drag p50 to ~0 under overload.)
+            # stacking latency the client will time out on anyway,
+            # with a Retry-After hint so a well-behaved client backs
+            # off instead of hammering.  (Sub-millisecond rejections
+            # stay out of the latency reservoir - they would drag p50
+            # to ~0 under overload.)
             st.metrics.observe_response(False)
-            return 429, {"status": "error", "error": str(e)}, {}
+            return 429, {
+                "status": "error", "error": str(e), "retriable": True,
+            }, {"Retry-After": "1"}
         except Exception as e:
             # A closed batcher ("batcher is closed" during shutdown)
             # gets its 500 JSON, not a connection reset - the
             # historical handler's contract.
             st.metrics.observe_response(False)
             return 500, {"status": "error", "error": str(e)}, {}
-        try:
-            lane_result, lane_error, batch_info = fut.result(
-                st.request_timeout
+        # The handler never outwaits the caller's deadline: with a
+        # budget set, the wait on the future is bounded by it (plus a
+        # small grace for a result racing in), so "no future ever hangs
+        # past its deadline" holds even when the scheduler is wedged
+        # mid-batch.  Without a budget the historical request_timeout
+        # stands.
+        wait_s = st.request_timeout
+        if deadline is not None:
+            wait_s = min(
+                wait_s, max(0.0, deadline - time.monotonic()) + 0.050
             )
+        try:
+            lane_result, lane_error, batch_info = fut.result(wait_s)
+        except DeadlineExceededError as e:
+            # The scheduler dropped it in queue: 504 with attribution.
+            st.metrics.observe_response(False)
+            payload = {
+                "status": "error", "error": str(e),
+                "deadline_ms": deadline_ms,
+            }
+            if e.queue_s is not None:
+                payload["queue_ms"] = round(e.queue_s * 1e3, 3)
+            return 504, payload, {}
+        except QuarantinedError as e:
+            # Circuit-broken program key: shed with the remaining
+            # cooldown as the Retry-After hint.
+            st.metrics.observe_response(False)
+            return 503, {
+                "status": "error", "error": str(e), "retriable": True,
+            }, {"Retry-After": str(max(1, int(e.retry_after_s + 0.5)))}
+        except WorkerCrashError as e:
+            # The scheduler worker died mid-batch and was restarted:
+            # the request itself is fine - retriable 503, never a hang.
+            st.metrics.observe_response(False)
+            return 503, {
+                "status": "error", "error": str(e), "retriable": True,
+            }, {"Retry-After": "1"}
+        except FuturesTimeoutError:
+            st.metrics.observe_response(False)
+            # 504 only when the DEADLINE is what ran out: a budget
+            # longer than request_timeout can cap the wait at the
+            # timeout with budget to spare, and that case must keep the
+            # historical (retriable-by-the-client) timeout 500, not
+            # masquerade as an expired deadline.
+            if deadline is not None and time.monotonic() >= deadline:
+                return 504, {
+                    "status": "error",
+                    "error": (
+                        f"deadline_ms {deadline_ms:g} expired while the "
+                        f"request was in flight (queue + execute "
+                        f"exceeded the budget)"
+                    ),
+                    "deadline_ms": deadline_ms,
+                }, {}
+            return 500, {
+                "status": "error",
+                "error": (
+                    f"request timed out after {wait_s:g}s"
+                ),
+            }, {}
         except Exception as e:
             st.metrics.observe_response(False)
             return 500, {"status": "error", "error": str(e)}, {}
@@ -559,6 +693,9 @@ def build_server(
     max_lane_cells: Optional[int] = None,
     record_trace: Optional[str] = None,
     server_timing: bool = True,
+    breaker_threshold: Optional[int] = 3,
+    breaker_cooldown_s: float = 30.0,
+    fault_plan=None,
 ) -> Tuple[ThreadingHTTPServer, ServerState]:
     """Assemble engine + batcher + HTTP server (port 0 = ephemeral; the
     bound port is `httpd.server_address[1]`).  Returned httpd is not yet
@@ -568,23 +705,34 @@ def build_server(
     DynamicBatcher); `max_queue` bounds the request queue (full ->
     429); `max_body_bytes`/`max_lane_cells` refuse oversized requests
     before scheduling (413/422); `record_trace` captures accepted
-    /solve traffic into a replayable loadgen scenario trace.  Engine
-    and metrics share ONE MetricsRegistry so the Prometheus exposition
-    at /metrics is a single consistent cut."""
+    /solve traffic into a replayable loadgen scenario trace.
+    `breaker_threshold`/`breaker_cooldown_s` configure the per-
+    ProgramKey circuit breaker (None disables); `fault_plan` (a
+    run/faults.ServeFaultPlan, default WAVETPU_FAULT) is ONE shared
+    chaos-injection plan across engine, scheduler, and handler so
+    count-limited budgets mean what they say.  Engine and metrics share
+    ONE MetricsRegistry so the Prometheus exposition at /metrics is a
+    single consistent cut."""
     from wavetpu.obs.registry import MetricsRegistry
+    from wavetpu.run import faults
     from wavetpu.serve.engine import ServeEngine
     from wavetpu.serve.scheduler import DynamicBatcher, ServeMetrics
 
     registry = MetricsRegistry()
+    if fault_plan is None:
+        fault_plan = faults.serve_plan_from_env()
     engine = ServeEngine(
         bucket_sizes=bucket_sizes, max_programs=max_programs,
         compute_errors=compute_errors, interpret=interpret,
         watchdog=watchdog, max_amp=max_amp, registry=registry,
+        breaker_threshold=breaker_threshold,
+        breaker_cooldown_s=breaker_cooldown_s, fault_plan=fault_plan,
     )
     metrics = ServeMetrics(registry=registry)
     batcher = DynamicBatcher(
         engine, metrics=metrics, max_batch=max_batch, max_wait=max_wait,
         length_bucket_steps=length_bucket_steps, max_queue=max_queue,
+        fault_plan=fault_plan,
     )
     recorder = None
     if record_trace is not None:
@@ -596,6 +744,7 @@ def build_server(
         engine, batcher, metrics, default_kernel,
         max_body_bytes=max_body_bytes, max_lane_cells=max_lane_cells,
         recorder=recorder, server_timing=server_timing,
+        fault_plan=fault_plan,
     )
     return httpd, httpd.wavetpu_state
 
@@ -640,6 +789,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             if "max-lane-cells" in flags else None
         )
         max_amp = float(flags["max-amp"]) if "max-amp" in flags else None
+        breaker_threshold = (
+            None if "no-breaker" in flags
+            else int(flags.get("breaker-threshold", "3"))
+        )
+        breaker_cooldown_s = float(flags.get("breaker-cooldown-s", "30"))
         kernel = flags.get("kernel", "auto")
         if kernel not in ("auto", "roll", "pallas"):
             raise ValueError(
@@ -673,6 +827,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         max_lane_cells=max_lane_cells,
         record_trace=flags.get("record-trace"),
         server_timing="no-server-timing" not in flags,
+        breaker_threshold=breaker_threshold,
+        breaker_cooldown_s=breaker_cooldown_s,
     )
     if state.recorder is not None:
         print(f"recording accepted /solve traffic: {flags['record-trace']}")
@@ -689,13 +845,36 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             )
             print(f"telemetry: {flags['telemetry-dir']}")
         if warmup_parts is not None:
+            # Warm in the BACKGROUND so /healthz answers `ready: false`
+            # while the compile runs (the load balancer's routing
+            # signal) instead of the listen backlog silently queueing
+            # probes until the compile finishes.  A warmup failure is
+            # recorded (healthz `warmup_error`) and the replica keeps
+            # serving - requests compile on demand like any cold key.
             wp = Problem(N=warmup_parts[0], timesteps=warmup_parts[1])
             k = warmup_parts[2] if len(warmup_parts) == 3 else 1
             path = "kfused" if k > 1 else (
                 "pallas" if jax.default_backend() == "tpu" else "roll"
             )
-            warmed = state.engine.warmup(wp, path=path, k=max(k, 2))
-            print(f"warmed buckets {warmed} for N={wp.N} path={path}")
+            state.warming = True
+
+            def _warm():
+                try:
+                    warmed = state.engine.warmup(wp, path=path,
+                                                 k=max(k, 2))
+                    print(
+                        f"warmed buckets {warmed} for N={wp.N} "
+                        f"path={path}"
+                    )
+                except Exception as e:
+                    state.warmup_error = str(e)
+                    print(f"warmup failed: {e}", file=sys.stderr)
+                finally:
+                    state.warming = False
+
+            threading.Thread(
+                target=_warm, name="wavetpu-warmup", daemon=True
+            ).start()
 
         bound = httpd.server_address
         print(

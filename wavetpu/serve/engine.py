@@ -19,6 +19,17 @@ guarded-amax reduction as run/health.py): a poisoned lane - NaN, Inf, or
 amplitude blowup from e.g. a Courant-unstable request - yields a per-lane
 error string while its batchmates' results stand.  One bad request can
 not sink the batch.
+
+Since the serving-resilience round the engine also carries a per-
+ProgramKey CIRCUIT BREAKER (serve/resilience.py): K consecutive
+compile/execute failures quarantine the key (batch bucket excluded - a
+tier is one breaker however it batches), so a poisoned tier sheds fast
+`QuarantinedError`s (HTTP 503 + Retry-After) instead of re-paying the
+failing compile on every request and stalling the single scheduler
+worker for everyone else.  After the cooldown one request probes
+half-open; success closes the breaker.  `run/faults.py`'s serve plan
+injects `compile-fail` (before the build) and `execute-nan` (after the
+solve, proving the watchdog catches it) at this layer.
 """
 
 from __future__ import annotations
@@ -33,7 +44,8 @@ from wavetpu.ensemble import batched as ensemble
 from wavetpu.ensemble import sharded as ens_sharded
 from wavetpu.obs import tracing
 from wavetpu.obs.registry import MetricsRegistry
-from wavetpu.run import health
+from wavetpu.run import faults, health
+from wavetpu.serve.resilience import CircuitBreaker, QuarantinedError
 
 
 class ProgramKey(NamedTuple):
@@ -91,6 +103,9 @@ class ServeEngine:
         max_amp: Optional[float] = None,
         block_x: Optional[int] = None,
         registry: Optional[MetricsRegistry] = None,
+        breaker_threshold: Optional[int] = 3,
+        breaker_cooldown_s: float = 30.0,
+        fault_plan: Optional[faults.ServeFaultPlan] = None,
     ):
         if not bucket_sizes or any(b < 1 for b in bucket_sizes):
             raise ValueError(f"bad bucket_sizes {bucket_sizes}")
@@ -132,6 +147,24 @@ class ServeEngine:
         # path -> recorded fallback reason (never silent; surfaced in
         # /metrics so an operator sees WHICH path refused to vmap).
         self.fallbacks: dict = {}
+        # Per-ProgramKey circuit breaker (None = disabled): K
+        # consecutive compile/execute failures quarantine the key
+        # bucket-wide; state rides both /metrics views.
+        self.breaker: Optional[CircuitBreaker] = (
+            None if breaker_threshold is None else CircuitBreaker(
+                threshold=breaker_threshold,
+                cooldown_s=breaker_cooldown_s, registry=self.registry,
+            )
+        )
+        # Chaos harness: the serve-path injection plan (shared server-
+        # wide by build_server; a standalone engine reads WAVETPU_FAULT
+        # itself).  None on the happy path - every seam is a None check.
+        self.fault_plan = (
+            fault_plan if fault_plan is not None
+            else faults.serve_plan_from_env()
+        )
+        if self.fault_plan is not None:
+            self.fault_plan.bind_registry(self.registry)
 
     # Cache hit/miss/eviction counts live in the registry counter - the
     # single source of truth for the JSON and Prometheus /metrics views;
@@ -240,6 +273,17 @@ class ServeEngine:
                 self._c_cache.inc(event="hit")
                 return prog, False, 0.0
             self._c_cache.inc(event="miss")
+        # Chaos seam: an injected compile failure lands exactly where a
+        # real Mosaic/XLA build error would - after the miss is counted,
+        # before any build work.
+        if self.fault_plan is not None and self.fault_plan.fire(
+            "compile-fail", n=problem.N, timesteps=problem.timesteps,
+            scheme=scheme, path=path, k=key.k, dtype=dtype_name,
+        ):
+            raise faults.InjectedFault(
+                f"injected compile failure ({scheme}:{path} "
+                f"N={problem.N}/{problem.timesteps})"
+            )
         # Build + compile OUTSIDE the lock (XLA compiles can take
         # seconds; warmup from another thread must not serialize on it).
         t0 = time.perf_counter()
@@ -288,6 +332,24 @@ class ServeEngine:
             ) is not None:
                 warmed.append(b)
         return warmed
+
+    def breaker_key(self, problem: Problem, scheme: str, path: str,
+                    k: int, dtype_name: str, with_field: bool,
+                    mesh: Optional[Tuple[int, int, int]] = None
+                    ) -> ProgramKey:
+        """The circuit-breaker identity: the ProgramKey with batch=0, so
+        every bucket of a tier shares one breaker (a poisoned compile
+        poisons the tier, not one bucket of it)."""
+        return ProgramKey.for_batch(
+            problem, scheme, path, k, dtype_name, with_field,
+            self.compute_errors and not with_field, 0, mesh,
+        )
+
+    def breaker_stats(self) -> dict:
+        """The JSON /metrics `breaker` block."""
+        if self.breaker is None:
+            return {"enabled": False}
+        return {"enabled": True, **self.breaker.snapshot()}
 
     def cache_stats(self) -> dict:
         with self._lock:
@@ -383,45 +445,69 @@ class ServeEngine:
         with_field = any(lane.c2tau2_field is not None for lane in lanes)
         compute_errors = self.compute_errors and not with_field
         bucket = self.bucket_for(len(lanes))
-        # Warm-vs-cold attribution: a solve whose program lookup had to
-        # compile is this key's first-request latency, not its steady
-        # state; the histogram label keeps the two populations apart.
-        # A capability-refused key runs the lane-loop fallback, whose
-        # per-lane compile behavior is jax-cache-dependent - its own
-        # label value, so fallback outliers never pollute either the
-        # warm or the cold batched population.
-        prog, missed, compile_seconds = self._program(
-            problem, scheme, path, k, dtype_name, with_field, bucket, mesh
-        )
-        warm = prog is not None and not missed
-        if timing is not None:
-            timing["compile_seconds"] = compile_seconds
-            timing["warm"] = (
-                "fallback" if prog is None
-                else "true" if warm else "false"
+        # Circuit breaker: an open key sheds HERE (fast QuarantinedError
+        # the HTTP layer maps to 503 + Retry-After) before any compile
+        # or device work; everything from program lookup through the
+        # batched execute counts as one admit/record cycle.  Per-lane
+        # watchdog trips are CLIENT errors (a Courant-unstable request)
+        # and never feed the breaker.
+        bkey = None
+        if self.breaker is not None:
+            bkey = self.breaker_key(
+                problem, scheme, path, k, dtype_name, with_field, mesh
             )
-        with tracing.span(
-            "serve.execute", scheme=scheme, path=path,
-            occupancy=len(lanes), bucket=bucket, warm=warm,
-        ) as sp:
-            if mesh is not None:
-                result = ens_sharded.solve_ensemble_sharded(
-                    problem, lanes, mesh_shape=mesh,
-                    dtype=self._dtype(dtype_name), kernel=path,
-                    compute_errors=compute_errors, interpret=self.interpret,
-                    pad_to=bucket if prog is not None else None,
-                    solver=prog,
+            self.breaker.admit(bkey)
+        try:
+            # Warm-vs-cold attribution: a solve whose program lookup had
+            # to compile is this key's first-request latency, not its
+            # steady state; the histogram label keeps the two
+            # populations apart.  A capability-refused key runs the
+            # lane-loop fallback, whose per-lane compile behavior is
+            # jax-cache-dependent - its own label value, so fallback
+            # outliers never pollute either the warm or the cold
+            # batched population.
+            prog, missed, compile_seconds = self._program(
+                problem, scheme, path, k, dtype_name, with_field, bucket,
+                mesh
+            )
+            warm = prog is not None and not missed
+            if timing is not None:
+                timing["compile_seconds"] = compile_seconds
+                timing["warm"] = (
+                    "fallback" if prog is None
+                    else "true" if warm else "false"
                 )
-            else:
-                result = ensemble.solve_ensemble(
-                    problem, lanes, dtype=self._dtype(dtype_name),
-                    scheme=scheme, path=path, k=k,
-                    compute_errors=compute_errors,
-                    interpret=self.interpret, block_x=self.block_x,
-                    pad_to=bucket if prog is not None else None,
-                    solver=prog,
-                )
-            sp["batched"] = result.batched
+            with tracing.span(
+                "serve.execute", scheme=scheme, path=path,
+                occupancy=len(lanes), bucket=bucket, warm=warm,
+            ) as sp:
+                if mesh is not None:
+                    result = ens_sharded.solve_ensemble_sharded(
+                        problem, lanes, mesh_shape=mesh,
+                        dtype=self._dtype(dtype_name), kernel=path,
+                        compute_errors=compute_errors,
+                        interpret=self.interpret,
+                        pad_to=bucket if prog is not None else None,
+                        solver=prog,
+                    )
+                else:
+                    result = ensemble.solve_ensemble(
+                        problem, lanes, dtype=self._dtype(dtype_name),
+                        scheme=scheme, path=path, k=k,
+                        compute_errors=compute_errors,
+                        interpret=self.interpret, block_x=self.block_x,
+                        pad_to=bucket if prog is not None else None,
+                        solver=prog,
+                    )
+                sp["batched"] = result.batched
+        except QuarantinedError:
+            raise
+        except Exception as e:
+            if self.breaker is not None:
+                self.breaker.record_failure(bkey, e)
+            raise
+        if self.breaker is not None:
+            self.breaker.record_success(bkey)
         self._h_execute.observe(
             result.solve_seconds,
             warm=(
@@ -433,4 +519,22 @@ class ServeEngine:
             self.fallbacks.setdefault(
                 f"{scheme}:{result.path}", result.fallback_reason
             )
+        # Chaos seam: execute-NaN poisons the batch's final state AFTER
+        # the solve - the per-lane watchdog below must catch it (422s),
+        # exactly as it would a real device fault.
+        if self.fault_plan is not None and self.fault_plan.fire(
+            "execute-nan", n=problem.N, timesteps=problem.timesteps,
+            scheme=scheme, path=path, k=k, dtype=dtype_name,
+        ):
+            import numpy as np
+
+            if result.u_cur_batch is not None:
+                result.u_cur_batch = np.full(
+                    np.shape(result.u_cur_batch), np.nan, np.float32
+                )
+            else:
+                for r in result.results:
+                    r.u_cur = np.full(
+                        np.shape(r.u_cur), np.nan, np.float32
+                    )
         return result, self.lane_health(result)

@@ -44,6 +44,8 @@ from wavetpu.ensemble.batched import LaneSpec
 from wavetpu.obs import tracing
 from wavetpu.obs.registry import MetricsRegistry
 from wavetpu.obs.report import percentile_nearest_rank
+from wavetpu.run import faults
+from wavetpu.serve.resilience import DeadlineExceededError, WorkerCrashError
 
 
 class QueueFullError(RuntimeError):
@@ -166,6 +168,16 @@ class ServeMetrics:
             "wavetpu_serve_last_batch_timestamp",
             "unix time the last batch finished (0 = none yet)",
         )
+        self._deadline_expired = r.counter(
+            "wavetpu_serve_deadline_expired_total",
+            "requests dropped because their deadline_ms budget expired "
+            "before execution (HTTP 504)",
+        )
+        self._worker_restarts = r.counter(
+            "wavetpu_serve_worker_restarts_total",
+            "scheduler-worker crashes absorbed by the supervisor "
+            "(in-flight futures failed retriable, worker restarted)",
+        )
         # Exact-percentile reservoir for the JSON snapshot's historical
         # latency_p50/p95_ms fields (the histogram above serves
         # Prometheus); guarded by the REGISTRY lock so snapshot() is one
@@ -189,6 +201,12 @@ class ServeMetrics:
 
     def observe_queue_depth(self, depth: int) -> None:
         self._queue_depth.set(depth)
+
+    def observe_deadline_expired(self) -> None:
+        self._deadline_expired.inc()
+
+    def observe_worker_restart(self) -> None:
+        self._worker_restarts.inc()
 
     def observe_batch(self, occupancy: int, batched: bool,
                       cells: float, solve_seconds: float,
@@ -286,6 +304,12 @@ class ServeMetrics:
                 "last_batch_age_seconds": (
                     None if age is None else round(age, 3)
                 ),
+                "deadline_expired_total": int(
+                    self._deadline_expired.value()
+                ),
+                "worker_restarts_total": int(
+                    self._worker_restarts.value()
+                ),
             }
 
 
@@ -299,6 +323,10 @@ class _Item:
     # attribution.
     request_id: Optional[str] = None
     enqueued: float = 0.0
+    # Absolute monotonic deadline (None = no budget): the worker drops
+    # an already-expired item at batch formation (HTTP 504) instead of
+    # marching work nobody is waiting for.
+    deadline: Optional[float] = None
 
 
 class DynamicBatcher:
@@ -314,6 +342,14 @@ class DynamicBatcher:
     refused, but everything already queued is FLUSHED through the engine
     (batched as usual, no max-wait idling) and every outstanding future
     resolves with its result instead of an error.
+
+    The worker runs under a SUPERVISOR (`_worker_main`): a crash fails
+    the in-flight batch's futures with a retriable `WorkerCrashError`
+    (503 + Retry-After) and restarts the loop - a wedged scheduler must
+    never strand blocked HTTP handlers.  Requests may carry an absolute
+    `deadline` (submit kwarg); already-expired items are dropped with
+    `DeadlineExceededError` (504) at batch formation instead of being
+    marched.  Both are no-ops when unused.
 
     `length_bucket_steps` is the occupancy/latency knob for diverging
     stop_steps: per-lane masking marches every lane to the batch's
@@ -332,9 +368,18 @@ class DynamicBatcher:
     def __init__(self, engine, metrics: Optional[ServeMetrics] = None,
                  max_batch: Optional[int] = None, max_wait: float = 0.025,
                  length_bucket_steps: Optional[int] = None,
-                 max_queue: Optional[int] = None):
+                 max_queue: Optional[int] = None,
+                 fault_plan: Optional[faults.ServeFaultPlan] = None):
         self.engine = engine
         self.metrics = metrics if metrics is not None else ServeMetrics()
+        # Chaos harness: worker-crash / slow-batch injections fire at
+        # this layer.  Default to the engine's plan so one WAVETPU_FAULT
+        # budget governs the whole stack (build_server passes the shared
+        # plan explicitly; engine-less stubs get None).
+        self.fault_plan = (
+            fault_plan if fault_plan is not None
+            else getattr(engine, "fault_plan", None)
+        )
         self.max_batch = (
             engine.max_batch if max_batch is None
             else min(max_batch, engine.max_batch)
@@ -364,8 +409,12 @@ class DynamicBatcher:
         self._plock = threading.Lock()
         self._closed = False
         self._drain = False
+        # The batch the worker currently holds OUTSIDE the queue/stash
+        # (supervisor bookkeeping): if the worker crashes mid-batch,
+        # these futures must be failed retriable, never stranded.
+        self._inflight: List[_Item] = []
         self._worker = threading.Thread(
-            target=self._loop, name="wavetpu-batcher", daemon=True
+            target=self._worker_main, name="wavetpu-batcher", daemon=True
         )
         self._worker.start()
 
@@ -394,10 +443,25 @@ class DynamicBatcher:
             self.metrics.observe_queue_depth(self._depth)
 
     def submit(self, request: SolveRequest,
-               request_id: Optional[str] = None) -> Future:
-        if self._closed:
-            raise RuntimeError("batcher is closed")
+               request_id: Optional[str] = None,
+               deadline: Optional[float] = None) -> Future:
+        """`deadline` is an absolute `time.monotonic()` bound (None =
+        unbounded, the historical behavior): the worker drops the item
+        with `DeadlineExceededError` if it is still queued past it."""
+        item = _Item(
+            request, Future(), self._item_key(request),
+            request_id=request_id, enqueued=time.monotonic(),
+            deadline=deadline,
+        )
+        # Closed-check + enqueue are ATOMIC against close() (which
+        # flips _closed under this same lock): a submit that passes the
+        # check has its item IN the queue before close()'s final sweep
+        # runs, so the item is either drained or failed fast - a racing
+        # submit can never strand a future in a dead queue
+        # (tests/test_serve.py pins the drain-vs-submit race).
         with self._plock:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
             if self.max_queue is not None and self._depth >= self.max_queue:
                 self.metrics.observe_rejected()
                 raise QueueFullError(
@@ -406,12 +470,8 @@ class DynamicBatcher:
                 )
             self._depth += 1
             self.metrics.observe_queue_depth(self._depth)
-        item = _Item(
-            request, Future(), self._item_key(request),
-            request_id=request_id, enqueued=time.monotonic(),
-        )
+            self._q.put(item)
         self.metrics.observe_request()
-        self._q.put(item)
         return item.future
 
     def close(self, timeout: float = 5.0, drain: bool = False) -> None:
@@ -419,8 +479,11 @@ class DynamicBatcher:
         queued through the engine first (graceful SIGTERM shutdown):
         outstanding futures resolve with RESULTS; only what the worker
         could not finish within `timeout` is failed."""
-        self._drain = drain
-        self._closed = True
+        with self._plock:
+            # Under _plock so no submit can pass its closed-check and
+            # enqueue after the final sweep below (see submit()).
+            self._drain = drain
+            self._closed = True
         self._q.put(None)  # wake the worker
         self._worker.join(timeout)
         if self._worker.is_alive():
@@ -459,6 +522,36 @@ class DynamicBatcher:
             self._q.put(None)
 
     # ---- worker ----
+
+    def _worker_main(self) -> None:
+        """The worker's SUPERVISOR: `_loop` returning means a clean
+        shutdown; `_loop` raising means the worker crashed mid-batch (a
+        scheduler bug, an injected `serve-worker-crash`, anything the
+        per-batch engine try does not cover).  The supervisor fails the
+        crashed batch's futures with a retriable `WorkerCrashError`
+        (HTTP 503 + Retry-After - a blocked handler must never sit out
+        its timeout) and re-enters the loop, so everything still queued
+        or stashed keeps getting served.  A short sleep between
+        restarts keeps a crash-looping bug from spinning hot."""
+        while True:
+            try:
+                self._loop()
+                return
+            except Exception as e:
+                self._crash_cleanup(e)
+                if self._closed and not self._drain:
+                    return
+                time.sleep(0.05)
+
+    def _crash_cleanup(self, exc: BaseException) -> None:
+        items, self._inflight = self._inflight, []
+        for item in items:
+            if not item.future.done():
+                item.future.set_exception(WorkerCrashError(
+                    f"scheduler worker crashed mid-batch ({exc!r}); "
+                    f"worker restarted - retry the request"
+                ))
+        self.metrics.observe_worker_restart()
 
     def _take_pending(self, key, limit: int) -> List[_Item]:
         taken, keep = [], deque()
@@ -525,7 +618,12 @@ class DynamicBatcher:
                 else:
                     with self._plock:
                         self._pending.append(nxt)
+            # Supervisor bookkeeping: these items live only in this
+            # local list now; if _execute crashes past its engine try,
+            # _worker_main fails them retriable instead of stranding.
+            self._inflight = batch
             self._execute(batch)
+            self._inflight = []
 
     def _execute(self, batch: List[_Item]) -> None:
         req0 = batch[0].request
@@ -534,6 +632,46 @@ class DynamicBatcher:
         t_formed = time.monotonic()
         waits = [max(0.0, t_formed - item.enqueued) for item in batch]
         self._dec_depth(len(batch))
+        # Deadline shedding: an item whose budget already expired in
+        # queue is dropped HERE (504 with queue attribution), before any
+        # compile or device work - marching a lane nobody is waiting for
+        # wastes the whole batch's FLOP budget.  No-deadline items (the
+        # historical path) are untouched.
+        live: List[_Item] = []
+        live_waits: List[float] = []
+        for item, wait in zip(batch, waits):
+            if item.deadline is not None and t_formed >= item.deadline:
+                self.metrics.observe_deadline_expired()
+                if not item.future.done():
+                    item.future.set_exception(DeadlineExceededError(
+                        f"deadline expired after {wait * 1e3:.0f} ms in "
+                        f"queue (dropped before execution)",
+                        queue_s=wait,
+                    ))
+            else:
+                live.append(item)
+                live_waits.append(wait)
+        if not live:
+            return
+        batch, waits = live, live_waits
+        # Chaos seams: a worker crash escapes to the supervisor (the
+        # engine try below must NOT absorb it - it models the thread
+        # dying, not the solve failing); a slow batch stalls the worker
+        # exactly where a pathological compile or device hang would.
+        plan = self.fault_plan
+        if plan is not None and plan.active:
+            ctx = dict(
+                n=req0.problem.N, timesteps=req0.problem.timesteps,
+                scheme=req0.scheme, path=req0.path, k=req0.k,
+                dtype=req0.dtype_name,
+            )
+            if plan.fire("worker-crash", **ctx):
+                raise faults.InjectedFault(
+                    "injected scheduler worker crash"
+                )
+            slow = plan.fire("slow-batch", **ctx)
+            if slow is not None:
+                time.sleep(slow.seconds)
         span = tracing.begin_span(
             "serve.batch",
             request_ids=[i.request_id for i in batch if i.request_id],
