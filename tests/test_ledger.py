@@ -1,0 +1,264 @@
+"""Compile-cost ledger contracts (wavetpu/obs/ledger.py).
+
+The acceptance drill: a fabricated two-restart session's what-if
+savings must equal the duplicate keys' MEASURED cold-compile seconds
+exactly, the warmup manifest must round-trip through ProgramKey
+parsing, the ledger must survive telemetry rotation untouched
+(append-only durability), and every record path must be a zero-file-I/O
+no-op when telemetry is unconfigured.
+"""
+
+import json
+import os
+
+import pytest
+
+from wavetpu.obs import ledger, telemetry, tracing
+
+
+def _key(**over):
+    base = dict(
+        N=512, Lx=1.0, Ly=1.0, Lz=1.0, T=1.0, timesteps=1000,
+        scheme="compensated", path="kfused", k=4, dtype="f32",
+        with_field=False, compute_errors=True, batch=4, mesh=None,
+    )
+    base.update(over)
+    return base
+
+
+class TestLedgerDurability:
+    def test_appends_across_two_process_lifetimes(self, tmp_path):
+        """Two CompileLedger instances on one path = two simulated
+        process lifetimes: entries accumulate, and the cold verdict is
+        per-PROCESS (a restarted process is cold on a key the old one
+        compiled - exactly what the what-if exists to count)."""
+        p = str(tmp_path / "compile_ledger.jsonl")
+        led1 = ledger.CompileLedger(p)
+        led1.record(_key(), 30.25, ts=1.0, pid=111)
+        led1.record(_key(batch=8), 31.5, ts=2.0, pid=111)
+        led1.close()
+        led2 = ledger.CompileLedger(p)  # "restart"
+        rec = led2.record(_key(), 28.75, ts=10.0, pid=222)
+        assert rec["cold"] is True  # fresh process: cold again
+        rec2 = led2.record(_key(), 0.01, ts=11.0, pid=222)
+        assert rec2["cold"] is False  # same process: in-process recompile
+        led2.close()
+        entries = ledger.load_ledger(p)
+        assert len(entries) == 4
+        assert [e["pid"] for e in entries] == [111, 111, 222, 222]
+
+    def test_what_if_savings_equal_duplicate_cold_seconds(self, tmp_path):
+        """The pinned acceptance: on a recorded two-restart session the
+        persistent-cache what-if saves EXACTLY the sum of the duplicate
+        keys' measured cold-compile seconds, and saved + residual equals
+        the total recorded compile seconds."""
+        p = str(tmp_path / "compile_ledger.jsonl")
+        led = ledger.CompileLedger(p)
+        # restart 1: two keys compile cold
+        led.record(_key(), 30.25, ts=1.0, pid=111)
+        led.record(_key(batch=8), 31.5, ts=2.0, pid=111)
+        led.close()
+        led = ledger.CompileLedger(p)
+        # restart 2: BOTH keys recompile cold (the duplicate set) plus
+        # one genuinely new key (not a duplicate, not saved)
+        led.record(_key(), 28.75, ts=10.0, pid=222)
+        led.record(_key(batch=8), 29.5, ts=11.0, pid=222)
+        led.record(_key(scheme="standard", path="pallas", k=1),
+                   5.125, ts=12.0, pid=222)
+        led.close()
+        agg = ledger.aggregate(ledger.load_ledger(p))
+        wi = agg["what_if_persistent_cache"]
+        assert wi["saved_s"] == 28.75 + 29.5  # exact, the measured values
+        assert wi["served_compiles"] == 2
+        assert agg["recompiled_across_restarts"] == 2
+        assert wi["saved_s"] + wi["residual_s"] == agg["total_compile_s"]
+        assert agg["processes"] == 2
+        assert agg["distinct_keys"] == 3
+
+    def test_in_process_warm_recompiles_not_credited(self, tmp_path):
+        """Eviction churn (cold=False recompiles inside one process) is
+        counted in total spend but never in the cross-process what-if -
+        its cost is jax-cache dependent, so crediting it would inflate
+        the savings claim."""
+        p = str(tmp_path / "compile_ledger.jsonl")
+        led = ledger.CompileLedger(p)
+        led.record(_key(), 30.0, ts=1.0, pid=111)
+        led.record(_key(), 0.5, ts=2.0, pid=111)  # churn: cold=False
+        led.close()
+        agg = ledger.aggregate(ledger.load_ledger(p))
+        assert agg["what_if_persistent_cache"]["saved_s"] == 0.0
+        assert agg["total_compile_s"] == 30.5
+        assert agg["recompiled_across_restarts"] == 0
+
+    def test_ledger_exempt_from_telemetry_rotation(self, tmp_path):
+        """Rotation interplay: a tiny max_bytes rotates trace.jsonl
+        (segments appear) while compile_ledger.jsonl keeps EVERY entry
+        in one un-rotated file - the append-only durability the
+        cross-restart accounting depends on."""
+        d = str(tmp_path / "tel")
+        tel = telemetry.start(d, interval=60.0, max_bytes=512, keep=2)
+        try:
+            for i in range(40):
+                tracing.event("spam", i=i, pad="x" * 64)
+                ledger.record_compile(_key(batch=i + 1), 1.0 + i,
+                                      ts=float(i), pid=999)
+        finally:
+            tel.stop()
+        assert os.path.exists(os.path.join(d, "trace.jsonl.1"))  # rotated
+        lp = os.path.join(d, ledger.LEDGER_FILENAME)
+        assert not os.path.exists(lp + ".1")  # ledger never rotates
+        entries = ledger.load_ledger(lp)
+        assert len(entries) == 40
+        assert [e["key"]["batch"] for e in entries] == list(range(1, 41))
+
+    def test_unconfigured_record_is_zero_file_io(self, tmp_path,
+                                                 monkeypatch):
+        """PR 5 discipline: with no telemetry, record_compile touches no
+        file (nothing appears even in cwd)."""
+        monkeypatch.chdir(tmp_path)
+        ledger.disable()
+        assert not ledger.enabled()
+        ledger.record_compile(_key(), 1.0)
+        assert list(tmp_path.iterdir()) == []
+
+    def test_telemetry_configures_and_stops_ledger(self, tmp_path):
+        d = str(tmp_path / "tel")
+        tel = telemetry.start(d, interval=60.0)
+        try:
+            assert ledger.enabled()
+            assert ledger.get_ledger().path == os.path.join(
+                d, ledger.LEDGER_FILENAME
+            )
+        finally:
+            tel.stop()
+        assert not ledger.enabled()
+
+
+class TestWarmupManifest:
+    def test_manifest_shape_and_key_round_trip(self, tmp_path):
+        """The manifest is the exact input shape for direction 2's
+        `wavetpu warmup --manifest`: flag field, version, and every key
+        round-trips dict -> ProgramKey -> dict bitwise (mesh tuples
+        included)."""
+        p = str(tmp_path / "compile_ledger.jsonl")
+        led = ledger.CompileLedger(p)
+        led.record(_key(), 30.0, ts=1.0, pid=1)
+        led.record(_key(), 29.0, ts=2.0, pid=2)  # duplicate: one manifest key
+        led.record(_key(scheme="standard", path="pallas", k=1,
+                        mesh=[2, 1, 1]), 7.0, ts=3.0, pid=1)
+        led.close()
+        manifest = ledger.warmup_manifest(ledger.load_ledger(p))
+        assert manifest[ledger.MANIFEST_FLAG] is True
+        assert manifest["version"] == 1
+        assert len(manifest["keys"]) == 2
+        from wavetpu.serve.engine import ProgramKey
+
+        for kd in manifest["keys"]:
+            pk = ledger.program_key_from_dict(kd)
+            assert isinstance(pk, ProgramKey)
+            if kd["mesh"] is not None:
+                assert pk.mesh == tuple(kd["mesh"])
+            assert ledger.key_from_program_key(pk) == kd
+
+    def test_unknown_key_fields_rejected(self):
+        with pytest.raises(ValueError, match="unknown ProgramKey"):
+            ledger.normalize_key(_key(bogus=1))
+
+
+class TestLedgerReportCLI:
+    def _fabricate(self, tmp_path):
+        p = str(tmp_path / "compile_ledger.jsonl")
+        led = ledger.CompileLedger(p)
+        led.record(_key(), 30.25, ts=1.0, pid=111)
+        # second "process": explicit cold=True (one writer instance here,
+        # so the per-process auto-verdict would say warm)
+        led.record(_key(), 28.75, ts=10.0, pid=222, cold=True)
+        led.close()
+        return p
+
+    def test_report_accepts_dir_or_file(self, tmp_path, capsys):
+        self._fabricate(tmp_path)
+        assert ledger.main([str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "what-if persistent AOT cache" in out
+        assert "28.750s saved" in out
+        assert "recompiled across restarts: 1 key(s)" in out
+
+    def test_report_json_and_manifest(self, tmp_path, capsys):
+        p = self._fabricate(tmp_path)
+        mpath = str(tmp_path / "warmup.json")
+        assert ledger.main(
+            [p, "--json", "--emit-warmup-manifest", mpath]
+        ) == 0
+        out = capsys.readouterr().out
+        agg = json.loads(out[: out.rindex("}") + 1])
+        assert agg["what_if_persistent_cache"]["saved_s"] == 28.75
+        with open(mpath) as f:
+            manifest = json.load(f)
+        assert manifest[ledger.MANIFEST_FLAG] is True
+        assert len(manifest["keys"]) == 1
+
+    def test_usage_errors(self, tmp_path, capsys):
+        assert ledger.main([]) == 2
+        assert ledger.main(["--bogus"]) == 2
+        assert ledger.main([str(tmp_path / "missing.jsonl")]) == 2
+        capsys.readouterr()
+
+    def test_malformed_lines_skipped(self, tmp_path, capsys):
+        """Junk in the append-only file - non-JSON, foreign record
+        types, a key with fields this version does not know (a newer
+        wavetpu wrote it), a missing compile_s - is skipped and
+        counted, never a crash: the report must survive any ledger a
+        past or future version appended to."""
+        p = self._fabricate(tmp_path)
+        future_key = dict(_key(), novel_field="from-the-future")
+        with open(p, "a") as f:
+            f.write("not json\n{\"type\": \"other\"}\n")
+            f.write(json.dumps({
+                "type": "compile", "ts": 20.0, "pid": 3, "cold": True,
+                "compile_s": 1.0, "key": future_key,
+            }) + "\n")
+            f.write(json.dumps({
+                "type": "compile", "ts": 21.0, "pid": 3, "cold": True,
+                "key": _key(),  # no compile_s
+            }) + "\n")
+        entries = ledger.load_ledger(p)
+        assert len(entries) == 2
+        assert ledger.main([p]) == 0  # report still runs clean
+        capsys.readouterr()
+
+
+class TestEngineLedgerIntegration:
+    def test_engine_compiles_land_in_ledger(self, tmp_path):
+        """The serve seam: a cache miss appends one cold entry whose key
+        round-trips to the exact ProgramKey the engine compiled; a hit
+        appends nothing; an eviction-forced recompile appends a
+        cold=False entry."""
+        from wavetpu.core.problem import Problem
+        from wavetpu.serve.engine import ProgramKey, ServeEngine
+
+        d = str(tmp_path / "tel")
+        tel = telemetry.start(d, interval=60.0)
+        try:
+            problem = Problem(N=8, timesteps=4)
+            eng = ServeEngine(bucket_sizes=(1,), max_programs=1,
+                              interpret=True)
+            assert eng.program(
+                problem, "standard", "roll", 1, "f32", False, 1
+            ) is not None
+            eng.program(problem, "standard", "roll", 1, "f32", False, 1)
+            # force an eviction, then recompile the first key
+            other = Problem(N=8, timesteps=6)
+            eng.program(other, "standard", "roll", 1, "f32", False, 1)
+            eng.program(problem, "standard", "roll", 1, "f32", False, 1)
+        finally:
+            tel.stop()
+        entries = ledger.load_ledger(
+            os.path.join(d, ledger.LEDGER_FILENAME)
+        )
+        assert len(entries) == 3  # miss, miss, recompile (no hit entry)
+        assert [e["cold"] for e in entries] == [True, True, False]
+        pk = ledger.program_key_from_dict(entries[0]["key"])
+        assert pk == ProgramKey.for_batch(
+            problem, "standard", "roll", 1, "f32", False, True, 1
+        )

@@ -446,6 +446,20 @@ class TestSoakAndRetries:
             assert rep["errors"] == 0
             assert rep["retried_requests"] == len(retried)
             assert rep["attempts_total"] == rep["requests"] + 2
+            # Per-tier breakout: the tier rows must partition the
+            # aggregate retry accounting exactly (which tier absorbed
+            # the drops is the question the aggregate-only fields hid).
+            assert sum(
+                t["attempts_total"] for t in rep["tiers"].values()
+            ) == rep["attempts_total"]
+            assert sum(
+                t["retried_requests"] for t in rep["tiers"].values()
+            ) == rep["retried_requests"]
+            retried_tiers = {o.scenario for o in retried}
+            for tier, row in rep["tiers"].items():
+                assert (row["retried_requests"] > 0) == (
+                    tier in retried_tiers
+                )
         finally:
             httpd.shutdown()
             state.batcher.close()

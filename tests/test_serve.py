@@ -780,6 +780,26 @@ class TestHTTP:
         assert code == 200
         assert body["status"] == "ok"
 
+    def test_healthz_memory_fields(self, server):
+        """Device-memory visibility: both fields present and unit-pinned
+        in the name (`_bytes`); None exactly when the backend has no
+        memory_stats() (the CPU backend CI runs on), else non-negative
+        ints."""
+        base, _ = server
+        code, body = _get(base, "/healthz")
+        assert code == 200
+        assert "memory_bytes_in_use" in body
+        assert "memory_peak_bytes" in body
+        for field in ("memory_bytes_in_use", "memory_peak_bytes"):
+            v = body[field]
+            assert v is None or (isinstance(v, int) and v >= 0)
+        # Both sides of the contract agree: None iff the probe says
+        # unsupported.
+        from wavetpu.obs import perf
+
+        snap = perf.memory_snapshot()
+        assert (body["memory_bytes_in_use"] is None) == (snap is None)
+
     def test_healthz_liveness_vs_readiness(self, server):
         """The readiness split: `status: ok` = the process serves HTTP;
         `ready` = route traffic here - false while the warmup compile

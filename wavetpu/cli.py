@@ -148,9 +148,12 @@ pick at runtime):
                                     jax.profiler.TraceAnnotation) plus
                                     periodic registry snapshots
                                     (DIR/heartbeat.jsonl to tail,
-                                    DIR/metrics.prom to scrape);
+                                    DIR/metrics.prom to scrape) plus the
+                                    append-only compile-cost ledger
+                                    (DIR/compile_ledger.jsonl);
                                     summarize with `wavetpu trace-report
-                                    DIR/trace.jsonl`
+                                    DIR/trace.jsonl` and
+                                    `wavetpu ledger-report DIR`
 
 Exit codes (docs/robustness.md): 0 complete; 2 usage or checkpoint-load
 error; 3 preempted but checkpointed (requeue + --resume); 4 numerical-
@@ -167,6 +170,15 @@ with `wavetpu.client.WavetpuClient` as the retrying client half).
 TRACE.jsonl [--kind K] [--request ID]` summarizes a --telemetry-dir
 span trace (per-kind count/total/p50/p95; critical-path view of one
 request - wavetpu/obs/report.py; rotated segment sets are read whole).
+`wavetpu ledger-report TELEMETRY_DIR [--json]
+[--emit-warmup-manifest OUT.json]` aggregates the compile-cost ledger
+(wavetpu/obs/ledger.py): per-ProgramKey compile spend, keys recompiled
+across restarts, a what-if simulation of the persistent AOT cache
+(ROADMAP direction 2), and the warmup-manifest export that direction's
+`wavetpu warmup --manifest` will consume.  `wavetpu profile --out DIR
+ARGS...` runs a full wavetpu command line under `jax.profiler` so the
+telemetry spans land inside the device trace, then prints a
+post-capture summary.
 `wavetpu loadgen generate|replay|gate` is the traffic-realism harness
 (wavetpu/loadgen/, docs/observability.md): generate or record mixed-
 scenario JSONL traces, replay them open-/closed-loop against a live
@@ -241,6 +253,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from wavetpu.loadgen import cli as loadgen_cli
 
         return loadgen_cli.main(argv[1:])
+    if argv and argv[0] == "ledger-report":
+        # Compile-cost ledger aggregator + persistent-cache what-if +
+        # warmup-manifest export (stdlib-only; never touches jax).
+        from wavetpu.obs import ledger as compile_ledger
+
+        return compile_ledger.main(argv[1:])
+    if argv and argv[0] == "profile":
+        # jax.profiler bracket around one solve or a serve window, so
+        # the telemetry span annotations land in a device trace.
+        from wavetpu.obs import perf as obs_perf
+
+        return obs_perf.profile_main(argv[1:])
     if "--version" in argv:
         from wavetpu import __version__
 
@@ -365,6 +389,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "usage: wavetpu N Np Lx Ly Lz [T] [timesteps] | "
             "wavetpu serve [...] | wavetpu trace-report TRACE.jsonl | "
             "wavetpu loadgen generate|replay|gate [...] | "
+            "wavetpu ledger-report DIR [...] | "
+            "wavetpu profile --out DIR ARGS... | "
             "wavetpu --version\n"
             "       wavetpu N Np Lx Ly Lz [T] [timesteps] "
             "[--backend auto|single|sharded] [--mesh MX,MY,MZ] "
@@ -1150,10 +1176,73 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             n_procs = 1
             variant = "TPU"
 
+        # Roofline attribution on the cli.solve span: read back the
+        # gauges record_solve just stamped at the solver entry point
+        # (ONE computation, no second model that could drift), under
+        # the same path label the solver used.  Traced runs only -
+        # untraced runs skip even the lookup.
+        span_extra = {}
+        if solve_span is not None:
+            try:
+                from wavetpu.obs.registry import get_registry as _greg
+
+                if backend == "sharded":
+                    _perf_path = (
+                        ("kfused_comp_sharded"
+                         if scheme == "compensated"
+                         else "sharded_kfused")
+                        if fuse_steps > 1 else "sharded"
+                    )
+                else:
+                    _perf_path = (
+                        ("kfused_comp" if scheme == "compensated"
+                         else "kfused")
+                        if fuse_steps > 1
+                        else ("compensated" if scheme == "compensated"
+                              else "leapfrog")
+                    )
+                _reg = _greg()
+                _gbps = _reg.gauge(
+                    "wavetpu_solve_model_gbps", "", ("path",)
+                ).value(path=_perf_path)
+                if _gbps:
+                    span_extra = {
+                        "model_gbps": _gbps,
+                        "roofline_fraction": _reg.gauge(
+                            "wavetpu_solve_roofline_fraction", "",
+                            ("path",)
+                        ).value(path=_perf_path),
+                    }
+            except Exception:
+                pass  # the X-ray must never fail a finished solve
         _tracing.end_span(
             solve_span, final_step=result.final_step,
             gcells_per_s=round(result.gcells_per_second, 3),
+            **span_extra,
         )
+        # Compile-cost ledger entry for the solo solve (no-op without
+        # --telemetry-dir): `init_seconds` is the CLI's compile proxy -
+        # grid init + build + XLA compile - the same figure bench.py
+        # records as compile_seconds per row.
+        from wavetpu.obs import ledger as _ledger
+
+        if _ledger.enabled():
+            try:
+                _dtype_names = {
+                    "float32": "f32", "float64": "f64",
+                    "bfloat16": "bf16",
+                }
+                _ledger.record_compile(_ledger.solo_key(
+                    problem, scheme,
+                    "kfused" if fuse_steps > 1 else kernel, fuse_steps,
+                    _dtype_names.get(
+                        jnp.dtype(result.u_cur.dtype).name, "f32"
+                    ),
+                    c2_field is not None, compute_errors,
+                    mesh=shape if backend == "sharded" else None,
+                ), result.init_seconds)
+            except Exception:
+                pass  # ledger bookkeeping must never fail the run
 
         if "save-state" in flags:
             from wavetpu.io import checkpoint as _ckpt

@@ -83,6 +83,12 @@ def build_report(result, trace_path: Optional[str] = None,
             "requests": len(sub),
             "ok": t_ok,
             "error_rate": round(1.0 - t_ok / len(sub), 4),
+            # Per-tier retry accounting (the aggregate-only fields below
+            # hid WHICH tier the retrying client was absorbing failures
+            # for - e.g. one circuit-broken tier retrying while the rest
+            # sail through).
+            "attempts_total": sum(o.attempts for o in sub),
+            "retried_requests": sum(1 for o in sub if o.attempts > 1),
         }
         row.update(_pcts(t_lat))
         st = [o.server_timing for o in sub if o.server_timing]
@@ -286,6 +292,22 @@ def format_gate(violations: Sequence[dict], report: dict,
         f"  {'error_rate':<18} {report.get('error_rate')!r:>10}"
         f"   reject_rate {report.get('reject_rate')!r}"
     )
+    att = report.get("attempts_total")
+    req = report.get("requests")
+    if att and req and att > req:
+        # Retry absorption, broken out per tier: the gate diff must say
+        # WHERE the retrying client worked, not just that it did.
+        lines.append(
+            f"  {'retries':<18} {report.get('retried_requests')} "
+            f"request(s) retried ({att} attempts / {req} requests)"
+        )
+        for tier, row in sorted((report.get("tiers") or {}).items()):
+            if row.get("retried_requests"):
+                lines.append(
+                    f"    {tier}: {row['retried_requests']} retried, "
+                    f"{row['attempts_total']} attempts / "
+                    f"{row['requests']} requests"
+                )
     if violations:
         lines.append("violations:")
         for v in violations:

@@ -6,8 +6,12 @@
    API that also opens matching `jax.profiler.TraceAnnotation`s.
  * `obs.metrics`   - the domain instruments (per-solve throughput,
    checkpoint I/O, supervisor counters).
+ * `obs.perf`      - performance X-ray: the shared analytic cost model
+   + roofline gauges, device-memory watermarks, `wavetpu profile`.
+ * `obs.ledger`    - persistent compile-cost ledger and
+   `wavetpu ledger-report` (what-if cache, warmup manifest).
  * `obs.telemetry` - `--telemetry-dir` glue: trace file + periodic
-   registry snapshots (heartbeat.jsonl / metrics.prom).
+   registry snapshots (heartbeat.jsonl / metrics.prom) + the ledger.
  * `obs.report`    - `wavetpu trace-report`: per-kind span stats and
    per-request critical-path views over a trace file.
 

@@ -21,17 +21,35 @@ Metric catalog (docs/observability.md is the user-facing copy):
   wavetpu_supervisor_retries_total      watchdog auto-retries taken
   wavetpu_supervisor_watchdog_trips_total   health-check failures
   wavetpu_supervisor_step               gauge: last completed layer
+
+Roofline + device-memory instruments (obs/perf.py owns the catalog):
+`record_solve` also stamps the shared analytic cost model's verdict
+(modeled GB/s, roofline fraction) for the config that ran and samples
+device memory - both host-side arithmetic at solve granularity.
 """
 
 from __future__ import annotations
 
+from typing import Optional
+
 from wavetpu.obs.registry import get_registry
 
 
-def record_solve(result, path: str) -> None:
+def record_solve(result, path: str, *, scheme: str = "standard",
+                 k: int = 1, v_itemsize: Optional[int] = None,
+                 carry: bool = True, with_field: bool = False,
+                 block_x: Optional[int] = None,
+                 depth: Optional[int] = None,
+                 ghosts: bool = False) -> Optional[dict]:
     """Per-solve throughput counters, called at solver entry points.
     `result` is a leapfrog.SolveResult; `path` names the solver family
-    (roll / pallas / kfused / kfused_comp / sharded / sharded_kfused)."""
+    (leapfrog / compensated / kfused / kfused_comp[_sharded] / sharded /
+    sharded_kfused).  The keyword args describe the config for the
+    roofline model (obs/perf.py) - sharded paths pass the shard
+    `depth`/`ghosts` their kernel's own block chooser used.  Returns
+    the roofline attribution dict (None when the config has no model);
+    the gauges it stamps are the canonical read path (cli.py reads
+    them back for the cli.solve span)."""
     reg = get_registry()
     problem = result.problem
     steps = (
@@ -57,6 +75,23 @@ def record_solve(result, path: str) -> None:
         "wavetpu_last_solve_gcells_per_s",
         "throughput of the most recent solve", ("path",)
     ).set(float(result.gcells_per_second or 0.0), path=path)
+    # Roofline attribution + device-memory sample (obs/perf.py): both a
+    # few host-side ops per solve; memory sampling short-circuits after
+    # one probe on backends without memory_stats().  Guarded: the X-ray
+    # must never fail the solve it measures.
+    try:
+        from wavetpu.obs import perf
+
+        attribution = perf.record_roofline(reg, path, perf.solve_perf(
+            float(result.gcells_per_second or 0.0), path, scheme=scheme,
+            k=k, n=problem.N, itemsize=result.u_cur.dtype.itemsize,
+            v_itemsize=v_itemsize, carry=carry, with_field=with_field,
+            block_x=block_x, depth=depth, ghosts=ghosts,
+        ))
+        perf.record_memory(reg, context="solve")
+        return attribution
+    except Exception:
+        return None
 
 
 def record_checkpoint_io(op: str, kind: str, nbytes: float,

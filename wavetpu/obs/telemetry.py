@@ -27,6 +27,7 @@ import threading
 import time
 from typing import Optional
 
+from wavetpu.obs import ledger as compile_ledger
 from wavetpu.obs import tracing
 from wavetpu.obs.registry import MetricsRegistry, get_registry
 
@@ -60,7 +61,15 @@ class Telemetry:
         self.trace_path = os.path.join(directory, TRACE_FILENAME)
         self.heartbeat_path = os.path.join(directory, HEARTBEAT_FILENAME)
         self.prom_path = os.path.join(directory, PROM_FILENAME)
+        self.ledger_path = os.path.join(
+            directory, compile_ledger.LEDGER_FILENAME
+        )
         tracing.configure(self.trace_path, max_bytes=max_bytes, keep=keep)
+        # Compile-cost ledger: append-only and deliberately EXEMPT from
+        # the size rotation below - one line per compile, and rotating
+        # away history would defeat the cross-restart accounting
+        # `wavetpu ledger-report` exists for (obs/ledger.py).
+        compile_ledger.configure(self.ledger_path)
         self._stop = threading.Event()
         self._stopped = False
         self._thread = threading.Thread(
@@ -121,6 +130,9 @@ class Telemetry:
         t = tracing.get_tracer()
         if t is not None and t.path == self.trace_path:
             tracing.disable()
+        led = compile_ledger.get_ledger()
+        if led is not None and led.path == self.ledger_path:
+            compile_ledger.disable()
 
 
 def start(directory: str, registry: Optional[MetricsRegistry] = None,

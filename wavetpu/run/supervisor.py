@@ -862,6 +862,7 @@ def supervise(problem, spec: PathSpec, opts: SupervisorOptions,
     import numpy as np
 
     from wavetpu.obs import metrics as obs_metrics
+    from wavetpu.obs import perf as obs_perf
     from wavetpu.obs import tracing
     from wavetpu.run import faults, health
 
@@ -935,6 +936,10 @@ def supervise(problem, spec: PathSpec, opts: SupervisorOptions,
                         compile_seconds=round(i_s, 6),
                     )
                     chunk_span = None
+                    # HBM pressure at chunk granularity: the watermark
+                    # gauge is how an OOM-adjacent supervised march is
+                    # seen coming (no-op on memory_stats-less backends).
+                    obs_perf.record_memory(context="supervisor")
                     abs_full[: b + 1] = a
                     rel_full[: b + 1] = r
                     init_s += i_s
@@ -953,6 +958,7 @@ def supervise(problem, spec: PathSpec, opts: SupervisorOptions,
                         compile_seconds=round(c_s, 6),
                     )
                     chunk_span = None
+                    obs_perf.record_memory(context="supervisor")
                     abs_full[cur + 1: cur + length + 1] = a
                     rel_full[cur + 1: cur + length + 1] = r
                     init_s += c_s

@@ -37,7 +37,8 @@ Endpoints (contract in docs/serving.md):
                  --no-breaker) while other tiers keep serving.
   GET /healthz   liveness AND readiness: {"status": "ok", "ready",
                  "uptime_seconds", "draining", "warming",
-                 "last_batch_age_seconds"} - `status` says the process
+                 "last_batch_age_seconds", "memory_bytes_in_use",
+                 "memory_peak_bytes"} - `status` says the process
                  serves HTTP, `ready` says ROUTE HERE (false while the
                  --warmup compile runs or once draining is set); a
                  load balancer distinguishes idle (no traffic, age
@@ -387,7 +388,14 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:  # noqa: N802 (stdlib contract)
         if self.path == "/healthz":
+            from wavetpu.obs import perf
+
             age = self.state.metrics.last_batch_age()
+            # Device-memory visibility for the balancer/autoscaler:
+            # None on backends without memory_stats() (CPU), else the
+            # allocator's live + peak byte counts.  Unit pinned in the
+            # field names, like last_batch_age_seconds.
+            mem = perf.memory_snapshot()
             # Liveness vs READINESS: "status: ok" = the process serves
             # HTTP (liveness); "ready" = route traffic here (false while
             # the warmup compile is still running, or once draining is
@@ -405,6 +413,12 @@ class _Handler(BaseHTTPRequestHandler):
                 "warming": self.state.warming,
                 "last_batch_age_seconds": (
                     None if age is None else round(age, 3)
+                ),
+                "memory_bytes_in_use": (
+                    None if mem is None else mem["bytes_in_use"]
+                ),
+                "memory_peak_bytes": (
+                    None if mem is None else mem["peak_bytes"]
                 ),
             }
             if self.state.warmup_error is not None:

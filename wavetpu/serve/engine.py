@@ -42,7 +42,8 @@ from typing import List, NamedTuple, Optional, Sequence, Tuple
 from wavetpu.core.problem import Problem
 from wavetpu.ensemble import batched as ensemble
 from wavetpu.ensemble import sharded as ens_sharded
-from wavetpu.obs import tracing
+from wavetpu.obs import ledger as compile_ledger
+from wavetpu.obs import perf, tracing
 from wavetpu.obs.registry import MetricsRegistry
 from wavetpu.run import faults, health
 from wavetpu.serve.resilience import CircuitBreaker, QuarantinedError
@@ -307,6 +308,14 @@ class ServeEngine:
             prog.compile()
         compile_seconds = time.perf_counter() - t0
         self._h_compile.observe(compile_seconds)
+        # Compile-cost ledger (obs/ledger.py): one appended line per
+        # compile, keyed by the full ProgramKey, surviving process
+        # restarts - the raw material for `wavetpu ledger-report`'s
+        # cross-restart accounting and warmup manifest.  A None-check
+        # no-op (zero file I/O) when no --telemetry-dir configured it.
+        compile_ledger.record_compile(
+            compile_ledger.key_from_program_key(key), compile_seconds
+        )
         with self._lock:
             self._programs[key] = prog
             self._programs.move_to_end(key)
@@ -500,6 +509,45 @@ class ServeEngine:
                         solver=prog,
                     )
                 sp["batched"] = result.batched
+                # Roofline attribution for the batch program: the
+                # vmapped march moves batch_size x the per-lane traffic
+                # (padding lanes stream bytes too), so the program-level
+                # Gcell/s - not just the real-lane aggregate - is what
+                # sits on the roofline.  Same attrs as the solo solve
+                # gauges, stamped on this serve.execute span and the
+                # server registry.
+                # Guarded: an X-ray bug must never fail the batch (an
+                # exception here would even feed the circuit breaker).
+                try:
+                    steps = max(
+                        (r.steps_computed or problem.timesteps
+                         for r in result.results),
+                        default=problem.timesteps,
+                    )
+                    prog_gcells = (
+                        problem.cells_per_step * result.batch_size
+                        * steps / result.solve_seconds / 1e9
+                        if result.solve_seconds else 0.0
+                    )
+                    rf = perf.record_roofline(
+                        self.registry, result.path, perf.solve_perf(
+                            prog_gcells, result.path, scheme=scheme,
+                            k=k, n=problem.N,
+                            itemsize=perf.DTYPE_ITEMSIZE.get(
+                                dtype_name, 4
+                            ),
+                            with_field=with_field,
+                        ),
+                    )
+                    if rf is not None:
+                        sp["model_bytes_per_cell"] = (
+                            rf["model_bytes_per_cell"]
+                        )
+                        sp["model_gbps"] = rf["model_gbps"]
+                        sp["roofline_fraction"] = rf["roofline_fraction"]
+                    perf.record_memory(self.registry, context="serve")
+                except Exception:
+                    pass
         except QuarantinedError:
             raise
         except Exception as e:

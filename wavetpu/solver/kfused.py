@@ -321,7 +321,10 @@ def solve_kfused(
         steps_computed=stop_step,
         final_step=stop_step if stop_step is not None else problem.timesteps,
     )
-    obs_metrics.record_solve(result, "kfused")
+    obs_metrics.record_solve(
+        result, "kfused", k=k, with_field=c2tau2_field is not None,
+        block_x=block_x,
+    )
     return result
 
 

@@ -424,7 +424,14 @@ def solve_kfused_comp(
         problem, out, init_s, solve_s, stop_step,
         stop_step if stop_step is not None else problem.timesteps,
     )
-    obs_metrics.record_solve(result, "kfused_comp")
+    obs_metrics.record_solve(
+        result, "kfused_comp", scheme="compensated", k=k,
+        v_itemsize=(
+            None if v_dtype is None else jnp.dtype(v_dtype).itemsize
+        ),
+        carry=carry, with_field=c2tau2_field is not None,
+        block_x=block_x,
+    )
     return result
 
 
@@ -825,7 +832,17 @@ def solve_kfused_comp_sharded(
         problem, out, init_s, solve_s, stop_step,
         stop_step if stop_step is not None else problem.timesteps,
     )
-    obs_metrics.record_solve(result, "kfused_comp_sharded")
+    obs_metrics.record_solve(
+        result, "kfused_comp_sharded", scheme="compensated", k=k,
+        v_itemsize=(
+            None if v_dtype is None else jnp.dtype(v_dtype).itemsize
+        ),
+        carry=carry, with_field=c2tau2_field is not None,
+        block_x=block_x,
+        # Same depth/ghosts arguments the sharded chooser above used,
+        # so the roofline model reads the block the kernel runs.
+        depth=problem.N // n_x, ghosts=True,
+    )
     return result
 
 

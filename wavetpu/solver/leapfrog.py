@@ -396,7 +396,14 @@ def solve(
         steps_computed=stop_step,
         final_step=stop_step if stop_step is not None else problem.timesteps,
     )
-    obs_metrics.record_solve(result, "leapfrog")
+    # A variable-c kernel arrives as a ParamStep (the field is a runtime
+    # argument by construction), so field presence is detectable here -
+    # the 1-step roofline model adds the field stream exactly when the
+    # kernel reads one.
+    obs_metrics.record_solve(
+        result, "leapfrog",
+        with_field=isinstance(step_fn, ParamStep),
+    )
     return result
 
 
@@ -513,7 +520,7 @@ def solve_compensated(
         comp_v=v,
         comp_carry=carry,
     )
-    obs_metrics.record_solve(result, "compensated")
+    obs_metrics.record_solve(result, "compensated", scheme="compensated")
     return result
 
 

@@ -846,7 +846,10 @@ def solve_sharded(
         comp_v=out[4] if scheme == "compensated" else None,
         comp_carry=out[5] if scheme == "compensated" else None,
     )
-    obs_metrics.record_solve(result, "sharded")
+    obs_metrics.record_solve(
+        result, "sharded", scheme=scheme,
+        with_field=c2tau2_field is not None,
+    )
     return result
 
 

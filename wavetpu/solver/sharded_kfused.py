@@ -841,7 +841,14 @@ def solve_sharded_kfused(
         steps_computed=stop_step,
         final_step=stop_step if stop_step is not None else problem.timesteps,
     )
-    obs_metrics.record_solve(result, "sharded_kfused")
+    obs_metrics.record_solve(
+        result, "sharded_kfused", k=k,
+        with_field=c2tau2_field is not None, block_x=block_x,
+        # Roofline model: the block is chosen against the SHARD depth
+        # with ghost buffers in the pipeline, same as the kernel's own
+        # chooser call above (ceil covers the pad-and-mask layout).
+        depth=-(-problem.N // n_x), ghosts=True,
+    )
     return result
 
 
