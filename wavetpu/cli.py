@@ -204,7 +204,7 @@ _KNOWN_FLAGS = (
     "kernel", "overlap", "scheme", "distributed", "profile",
     "fuse-steps", "debug-nans", "v-dtype", "c2-field",
     "ckpt-every", "ckpt-dir", "retries", "max-amp", "no-watchdog",
-    "telemetry-dir",
+    "telemetry-dir", "program-cache-dir",
 )
 _VALUELESS = (
     "no-errors", "phase-timing", "overlap", "distributed", "debug-nans",
@@ -265,6 +265,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from wavetpu.obs import perf as obs_perf
 
         return obs_perf.profile_main(argv[1:])
+    if argv and argv[0] == "warmup":
+        # Manifest-driven replica warmup: pre-populate a persistent
+        # program cache from a ledger-report warmup manifest.
+        from wavetpu.serve import progcache
+
+        return progcache.main(argv[1:])
     if "--version" in argv:
         from wavetpu import __version__
 
@@ -391,6 +397,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "wavetpu loadgen generate|replay|gate [...] | "
             "wavetpu ledger-report DIR [...] | "
             "wavetpu profile --out DIR ARGS... | "
+            "wavetpu warmup --manifest MANIFEST.json [...] | "
             "wavetpu --version\n"
             "       wavetpu N Np Lx Ly Lz [T] [timesteps] "
             "[--backend auto|single|sharded] [--mesh MX,MY,MZ] "
@@ -402,6 +409,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "[--save-state PATH] [--resume PATH] "
             "[--ckpt-every S] [--ckpt-dir DIR] [--retries N] "
             "[--max-amp X] [--no-watchdog] [--telemetry-dir DIR] "
+            "[--program-cache-dir DIR] "
             "[--out-dir DIR] [--platform NAME]",
             file=sys.stderr,
         )
@@ -741,6 +749,26 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
         telemetry = _telemetry.start(flags["telemetry-dir"])
         say(f"telemetry: {flags['telemetry-dir']}")
+    xla_cache_hits = None
+    if "program-cache-dir" in flags and is_main:
+        # Solo solvers jit internally (no executable object to adopt),
+        # so persistence here is JAX's own compilation cache scoped to
+        # DIR/xla - same directory layout the serve engine's fallback
+        # tier uses, so one --program-cache-dir serves both surfaces.
+        # The hit counter marks the ledger entry `source: disk` when
+        # the cache actually served this solve's compile.
+        from wavetpu.serve import progcache as _progcache
+
+        if _progcache.enable_xla_cache(
+            __import__("os").path.join(
+                flags["program-cache-dir"], "xla"
+            )
+        ):
+            xla_cache_hits = _progcache.shared_xla_hit_counter()
+            say(f"program cache: {flags['program-cache-dir']} "
+                f"[XLA persistent compilation cache]")
+        else:
+            say("program cache: unavailable on this jax")
     solve_span = _tracing.begin_span(
         "cli.solve", backend=backend, scheme=scheme, kernel=kernel,
         fuse_steps=fuse_steps, n=problem.N,
@@ -1240,7 +1268,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     ),
                     c2_field is not None, compute_errors,
                     mesh=shape if backend == "sharded" else None,
-                ), result.init_seconds)
+                ), result.init_seconds, source=(
+                    # The persistent XLA cache serves inside init (no
+                    # adoptable executable on the solo path): hits on
+                    # the monitoring listener mean disk paid for this
+                    # compile, so the ledger attributes it there.
+                    "disk" if (xla_cache_hits is not None
+                               and xla_cache_hits.hits > 0)
+                    else ("fresh" if xla_cache_hits is not None
+                          else None)
+                ))
             except Exception:
                 pass  # ledger bookkeeping must never fail the run
 

@@ -838,6 +838,30 @@ class EnsembleSolver:
         self.compile_seconds = time.perf_counter() - t0
         return self.compile_seconds
 
+    def executable_payload(self):
+        """The serialized compiled executable - (payload_bytes,
+        in_tree, out_tree) for the persistent program cache
+        (serve/progcache.py) - or None when not yet compiled.  Raises
+        where the jaxlib cannot serialize; callers probe
+        `progcache.aot_capability()` first."""
+        if self._exec is None:
+            return None
+        from jax.experimental import serialize_executable as se
+
+        return se.serialize(self._exec)
+
+    def adopt_executable(self, payload) -> float:
+        """Install a deserialized executable (the disk tier's warm
+        path - skips lower+compile entirely); returns the deserialize
+        wall seconds.  Raises on an incompatible payload - the caller
+        counts it a cache miss and compiles fresh."""
+        from jax.experimental import serialize_executable as se
+
+        t0 = time.perf_counter()
+        self._exec = se.deserialize_and_load(*payload)
+        self.compile_seconds = time.perf_counter() - t0
+        return self.compile_seconds
+
     def run(self, lanes: Sequence[LaneSpec]):
         """Execute the batch; returns (outputs, init_seconds,
         solve_seconds) with outputs = (u_prev_b, u_cur_b, abs_b, rel_b).

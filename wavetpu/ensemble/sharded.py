@@ -264,6 +264,26 @@ class ShardedEnsembleSolver:
         self.compile_seconds = time.perf_counter() - t0
         return self.compile_seconds
 
+    def executable_payload(self):
+        """Serialized executable for the persistent program cache -
+        same contract as `batched.EnsembleSolver.executable_payload`
+        (the two program types share the disk tier)."""
+        if self._exec is None:
+            return None
+        from jax.experimental import serialize_executable as se
+
+        return se.serialize(self._exec)
+
+    def adopt_executable(self, payload) -> float:
+        """Install a deserialized executable; see
+        `batched.EnsembleSolver.adopt_executable`."""
+        from jax.experimental import serialize_executable as se
+
+        t0 = time.perf_counter()
+        self._exec = se.deserialize_and_load(*payload)
+        self.compile_seconds = time.perf_counter() - t0
+        return self.compile_seconds
+
     def run(self, lanes: Sequence[LaneSpec]):
         import jax
 

@@ -24,6 +24,9 @@ baseline-less replay when passed explicitly - the chaos smoke's
     --reject-budget F          allowed 429 fraction
     --p99-regression-pct P     p99 may grow P% over the baseline (50)
     --throughput-floor-pct P   req/s may drop P% under the baseline (50)
+    --max-cold-compiles N      fresh-compile cap for the replay window
+                               (0 = a warm program cache must serve
+                               every program - the restart drill)
 
 Exit codes: 0 pass / generated / replayed; 1 SLO violation (the
 regression gate failed); 2 usage, unreadable input, or preflight
@@ -50,6 +53,7 @@ _SLO_FLAGS = {
     "reject-budget": ("reject_budget", float),
     "p99-regression-pct": ("p99_regression_pct", float),
     "throughput-floor-pct": ("throughput_floor_pct", float),
+    "max-cold-compiles": ("max_cold_compiles", int),
 }
 
 
@@ -168,7 +172,8 @@ def _replay(argv: Sequence[str]) -> int:
         f"ok {report['ok']}, 429 {report['rejected_429']}, errors "
         f"{report['errors']}; p50 {lat['p50_ms']}ms p99 {lat['p99_ms']}ms; "
         f"occupancy {occ}; cold compiles "
-        f"{report['server']['cold_compiles']}"
+        f"{report['server']['cold_compiles']}; disk hits "
+        f"{report['server']['disk_hits']}"
     )
     if retries:
         print(
@@ -184,7 +189,8 @@ def _replay(argv: Sequence[str]) -> int:
         return _run_gate(report, flags["baseline"], slo)
     absolute = {
         k: v for k, v in slo.items()
-        if k in ("p99_budget_ms", "error_budget", "reject_budget")
+        if k in ("p99_budget_ms", "error_budget", "reject_budget",
+                 "max_cold_compiles")
     }
     if absolute:
         # An explicitly-passed ABSOLUTE SLO gates even without a

@@ -38,6 +38,8 @@ DEFAULT_SLO = {
     "reject_budget": None,        # allowed 429 fraction (None = off)
     "p99_regression_pct": 50.0,   # p99 may grow this % over baseline
     "throughput_floor_pct": 50.0,  # req/s may drop this % under baseline
+    "max_cold_compiles": None,    # fresh-compile cap (0 = "a warm
+                                  # replica must compile nothing")
 }
 
 _TIMING_KEYS = ("queue", "compile", "execute", "padding")
@@ -132,7 +134,10 @@ def build_report(result, trace_path: Optional[str] = None,
             after, before, "wavetpu_serve_fallback_batches_total"
         )),
         # Cold-vs-warm program traffic during the replay window: misses
-        # are compiles the replay paid, hits are the warmed steady state.
+        # are FRESH compiles the replay paid, hits the warmed steady
+        # state, disk_hits persistent-cache adoptions (a restarted
+        # replica with a warm --program-cache-dir shows disk_hits > 0
+        # and cold_compiles == 0 - the "compiled nothing" CI assert).
         "cold_compiles": int(_delta(
             after, before,
             'wavetpu_program_cache_events_total{event="miss"}',
@@ -140,6 +145,10 @@ def build_report(result, trace_path: Optional[str] = None,
         "warm_hits": int(_delta(
             after, before,
             'wavetpu_program_cache_events_total{event="hit"}',
+        )),
+        "disk_hits": int(_delta(
+            after, before,
+            'wavetpu_program_cache_events_total{event="disk_hit"}',
         )),
         "evictions": int(_delta(
             after, before,
@@ -241,6 +250,15 @@ def gate(report: dict, baseline: Optional[dict] = None,
         fail("reject_budget", rej, cfg["reject_budget"],
              f"429 reject rate {rej} exceeds budget "
              f"{cfg['reject_budget']}")
+    # Persistent-cache gate: a replay against a replica whose program
+    # cache SHOULD be warm (second replica start) asserts zero fresh
+    # compiles here - the CI-checkable form of "restart paid nothing".
+    cold = (report.get("server") or {}).get("cold_compiles")
+    if cfg["max_cold_compiles"] is not None and cold is not None \
+            and cold > cfg["max_cold_compiles"]:
+        fail("max_cold_compiles", cold, cfg["max_cold_compiles"],
+             f"{cold} fresh compile(s) during replay exceeds budget "
+             f"{cfg['max_cold_compiles']} (program cache not warm)")
 
     if baseline is not None:
         base_p99 = (baseline.get("latency_ms") or {}).get("p99_ms")
@@ -292,6 +310,15 @@ def format_gate(violations: Sequence[dict], report: dict,
         f"  {'error_rate':<18} {report.get('error_rate')!r:>10}"
         f"   reject_rate {report.get('reject_rate')!r}"
     )
+    srv = report.get("server") or {}
+    if "cold_compiles" in srv:
+        # Compile traffic during the window: the line CI greps to prove
+        # a restarted replica served entirely from the persistent cache.
+        lines.append(
+            f"  {'compiles':<18} {srv.get('cold_compiles')} fresh, "
+            f"{srv.get('disk_hits', 0)} disk hit(s), "
+            f"{srv.get('warm_hits')} warm hit(s)"
+        )
     att = report.get("attempts_total")
     req = report.get("requests")
     if att and req and att > req:

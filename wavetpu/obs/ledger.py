@@ -138,7 +138,14 @@ class CompileLedger:
 
     def record(self, key: dict, compile_s: float,
                cold: Optional[bool] = None, ts: Optional[float] = None,
-               pid: Optional[int] = None) -> dict:
+               pid: Optional[int] = None, source: Optional[str] = None,
+               fresh_compile_s: Optional[float] = None) -> dict:
+        """`source` (persistent-cache round): "fresh" = a real XLA
+        compile, "disk" = the persistent program cache served it -
+        `compile_s` is then the DESERIALIZE wall and `fresh_compile_s`
+        the compile the entry replaced (the measured-savings credit).
+        None omits the field - the pre-cache line format, which
+        `aggregate` treats as fresh."""
         canon = canonical_key(key)
         with self._lock:
             if cold is None:
@@ -152,6 +159,10 @@ class CompileLedger:
                 "compile_s": round(float(compile_s), 6),
                 "key": normalize_key(key),
             }
+            if source is not None:
+                rec["source"] = str(source)
+            if fresh_compile_s is not None:
+                rec["fresh_compile_s"] = round(float(fresh_compile_s), 6)
             try:
                 if not self._f.closed:
                     self._f.write(json.dumps(rec) + "\n")
@@ -258,10 +269,22 @@ def aggregate(records: Sequence[dict]) -> dict:
     the persistent-cache what-if (see module docstring for the saving
     rule).  `what_if.saved_s + what_if.residual_s` equals the total
     recorded compile seconds EXACTLY - the self-validation the tests
-    pin."""
+    pin.
+
+    Since the persistent-cache round, `source: disk` records (the
+    cache actually serving a key; compile_s is the deserialize wall)
+    are partitioned OUT of the compile accounting - they are not
+    compiles - and reported as `measured_persistent_cache`: measured
+    savings next to the simulation.  Old-format lines with no `source`
+    are fresh compiles, so pre-cache ledgers aggregate bit-identically
+    to before."""
     records = sorted(
         records, key=lambda r: (r.get("ts", 0.0), r.get("pid", 0))
     )
+    disk_records = [
+        r for r in records if r.get("source") == "disk"
+    ]
+    records = [r for r in records if r.get("source") != "disk"]
     per: Dict[str, dict] = {}
     pids = set()
     for rec in records:
@@ -303,6 +326,20 @@ def aggregate(records: Sequence[dict]) -> dict:
         row["total_s"] = round(row["total_s"], 6)
         row["cold_s"] = round(row["cold_s"], 6)
         row["saved_s"] = round(row["saved_s"], 6)
+    # Measured reconciliation of the what-if: every `source: disk`
+    # record is one compile the REAL persistent cache served -
+    # compile_s is its deserialize wall, fresh_compile_s the compile it
+    # replaced.  Where both exist the measured saving is their
+    # difference (floored at 0); hits whose entry predates the
+    # fresh_compile_s field are counted unattributed.
+    measured_saved = 0.0
+    unattributed = 0
+    for rec in disk_records:
+        fresh = rec.get("fresh_compile_s")
+        if isinstance(fresh, (int, float)):
+            measured_saved += max(0.0, fresh - rec["compile_s"])
+        else:
+            unattributed += 1
     return {
         "entries": len(records),
         "distinct_keys": len(per),
@@ -317,6 +354,14 @@ def aggregate(records: Sequence[dict]) -> dict:
                 row["cold_compiles"] - 1
                 for row in per.values() if row["cold_compiles"] > 1
             ),
+        },
+        "measured_persistent_cache": {
+            "disk_hits": len(disk_records),
+            "load_s": round(
+                sum(r["compile_s"] for r in disk_records), 6
+            ),
+            "measured_saved_s": round(measured_saved, 6),
+            "unattributed_hits": unattributed,
         },
     }
 
@@ -375,6 +420,21 @@ def format_report(agg: dict) -> str:
         f"served compile(s); {wi['residual_s']:.3f}s residual "
         f"(first-compile + in-process churn)",
     ]
+    mp = agg.get("measured_persistent_cache") or {}
+    if mp.get("disk_hits"):
+        # The what-if became a measured fact: print them side by side.
+        line = (
+            f"measured persistent cache: {mp['disk_hits']} disk "
+            f"hit(s) served in {mp['load_s']:.3f}s deserialize, "
+            f"{mp['measured_saved_s']:.3f}s compile spend saved "
+            f"(measured)"
+        )
+        if mp.get("unattributed_hits"):
+            line += (
+                f"; {mp['unattributed_hits']} hit(s) without a "
+                f"recorded fresh-compile cost"
+            )
+        lines.append(line)
     return "\n".join(lines)
 
 

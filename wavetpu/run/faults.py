@@ -37,7 +37,14 @@ and HTTP layer consult at their seams -
    raises mid-batch (its supervisor must restart it and fail in-flight
    futures with retriable 503s, never hang them);
  * `serve-conn-drop[:count=N]`             - the HTTP handler closes
-   the connection without a response (client transport-retry drill).
+   the connection without a response (client transport-retry drill);
+ * `serve-progcache-truncate[:SELECTOR,count=N]` - a matching
+   persistent program-cache entry is truncated ON DISK just before the
+   read (serve/progcache.py), driving the real checksum/length
+   rejection branch: a counted miss and a clean recompile;
+ * `serve-progcache-fingerprint[:SELECTOR,count=N]` - the expected
+   environment fingerprint is poisoned for one load, driving the real
+   cross-version rejection branch the same way.
 
 SELECTOR is `field=value` pairs matched against the batch's program
 identity (`n`, `timesteps`, `scheme`, `path`, `k`, `dtype`), so one
@@ -196,7 +203,8 @@ def hook_from_env(env: Optional[dict] = None):
 
 
 SERVE_KINDS = ("compile-fail", "execute-nan", "slow-batch",
-               "worker-crash", "conn-drop")
+               "worker-crash", "conn-drop", "progcache-truncate",
+               "progcache-fingerprint")
 
 # Program-identity fields a selector may match on (ctx keys the serve
 # seams pass to `fire`).

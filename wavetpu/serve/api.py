@@ -98,7 +98,9 @@ _USAGE = (
     "[--kernel auto|roll|pallas] "
     "[--no-errors] [--max-amp X] [--no-watchdog] [--no-server-timing] "
     "[--breaker-threshold K] [--breaker-cooldown-s S] [--no-breaker] "
-    "[--warmup N,TIMESTEPS[,K]] [--platform NAME] "
+    "[--warmup N,TIMESTEPS[,K]] [--warmup-manifest MANIFEST.json] "
+    "[--program-cache-dir DIR] [--program-cache-max-bytes B] "
+    "[--platform NAME] "
     "[--telemetry-dir DIR] [--record-trace FILE.jsonl] [--version]"
 )
 
@@ -108,7 +110,9 @@ _KNOWN = (
     "max-body-bytes", "max-lane-cells", "kernel",
     "no-errors", "max-amp", "no-watchdog", "no-server-timing",
     "breaker-threshold", "breaker-cooldown-s", "no-breaker",
-    "warmup", "platform", "telemetry-dir", "record-trace", "version",
+    "warmup", "warmup-manifest", "program-cache-dir",
+    "program-cache-max-bytes", "platform", "telemetry-dir",
+    "record-trace", "version",
 )
 _VALUELESS = ("no-errors", "no-watchdog", "no-server-timing",
               "no-breaker", "version")
@@ -710,6 +714,8 @@ def build_server(
     breaker_threshold: Optional[int] = 3,
     breaker_cooldown_s: float = 30.0,
     fault_plan=None,
+    program_cache_dir: Optional[str] = None,
+    program_cache_max_bytes: Optional[int] = None,
 ) -> Tuple[ThreadingHTTPServer, ServerState]:
     """Assemble engine + batcher + HTTP server (port 0 = ephemeral; the
     bound port is `httpd.server_address[1]`).  Returned httpd is not yet
@@ -726,7 +732,9 @@ def build_server(
     chaos-injection plan across engine, scheduler, and handler so
     count-limited budgets mean what they say.  Engine and metrics share
     ONE MetricsRegistry so the Prometheus exposition at /metrics is a
-    single consistent cut."""
+    single consistent cut.  `program_cache_dir` adds the persistent
+    disk tier under the engine's program LRU (serve/progcache.py), so
+    compiled programs survive process restarts."""
     from wavetpu.obs.registry import MetricsRegistry
     from wavetpu.run import faults
     from wavetpu.serve.engine import ServeEngine
@@ -741,6 +749,8 @@ def build_server(
         watchdog=watchdog, max_amp=max_amp, registry=registry,
         breaker_threshold=breaker_threshold,
         breaker_cooldown_s=breaker_cooldown_s, fault_plan=fault_plan,
+        program_cache_dir=program_cache_dir,
+        program_cache_max_bytes=program_cache_max_bytes,
     )
     metrics = ServeMetrics(registry=registry)
     batcher = DynamicBatcher(
@@ -818,7 +828,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             warmup_parts = [int(x) for x in flags["warmup"].split(",")]
             if len(warmup_parts) not in (2, 3):
                 raise ValueError("--warmup wants N,TIMESTEPS[,K]")
-    except ValueError as e:
+        warmup_manifest = None
+        if "warmup-manifest" in flags:
+            # Parsed at flag time (a typo'd path or a non-manifest JSON
+            # is a usage error, not a silent forever-unready replica).
+            from wavetpu.serve import progcache as _progcache
+
+            warmup_manifest = _progcache.load_manifest(
+                flags["warmup-manifest"]
+            )
+        program_cache_max_bytes = (
+            int(flags["program-cache-max-bytes"])
+            if "program-cache-max-bytes" in flags else None
+        )
+    except (ValueError, OSError) as e:
         print(f"error: {e}", file=sys.stderr)
         print(_USAGE, file=sys.stderr)
         return 2
@@ -843,7 +866,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         server_timing="no-server-timing" not in flags,
         breaker_threshold=breaker_threshold,
         breaker_cooldown_s=breaker_cooldown_s,
+        program_cache_dir=flags.get("program-cache-dir"),
+        program_cache_max_bytes=program_cache_max_bytes,
     )
+    if state.engine.progcache is not None:
+        pc = state.engine.progcache
+        mode = (
+            "AOT serialized executables" if pc.usable
+            else "XLA persistent-cache fallback" if pc.xla_fallback
+            else "DISABLED (no mechanism)"
+        )
+        print(f"program cache: {pc.directory} [{mode}]")
     if state.recorder is not None:
         print(f"recording accepted /solve traffic: {flags['record-trace']}")
     telemetry = None
@@ -858,28 +891,77 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 flags["telemetry-dir"], registry=state.metrics.registry
             )
             print(f"telemetry: {flags['telemetry-dir']}")
-        if warmup_parts is not None:
+        if warmup_parts is not None or warmup_manifest is not None:
             # Warm in the BACKGROUND so /healthz answers `ready: false`
             # while the compile runs (the load balancer's routing
             # signal) instead of the listen backlog silently queueing
             # probes until the compile finishes.  A warmup failure is
             # recorded (healthz `warmup_error`) and the replica keeps
             # serving - requests compile on demand like any cold key.
-            wp = Problem(N=warmup_parts[0], timesteps=warmup_parts[1])
-            k = warmup_parts[2] if len(warmup_parts) == 3 else 1
-            path = "kfused" if k > 1 else (
-                "pallas" if jax.default_backend() == "tpu" else "roll"
-            )
+            # --warmup (single tier, all buckets) and --warmup-manifest
+            # (every key a ledger-report manifest names, through the
+            # engine so disk adoptions land in the LRU too) share ONE
+            # thread: readiness flips only once BOTH are done.
             state.warming = True
 
             def _warm():
                 try:
-                    warmed = state.engine.warmup(wp, path=path,
-                                                 k=max(k, 2))
-                    print(
-                        f"warmed buckets {warmed} for N={wp.N} "
-                        f"path={path}"
-                    )
+                    if warmup_parts is not None:
+                        wp = Problem(N=warmup_parts[0],
+                                     timesteps=warmup_parts[1])
+                        k = (warmup_parts[2]
+                             if len(warmup_parts) == 3 else 1)
+                        path = "kfused" if k > 1 else (
+                            "pallas" if jax.default_backend() == "tpu"
+                            else "roll"
+                        )
+                        warmed = state.engine.warmup(wp, path=path,
+                                                     k=max(k, 2))
+                        print(
+                            f"warmed buckets {warmed} for N={wp.N} "
+                            f"path={path}"
+                        )
+                    if warmup_manifest is not None:
+                        from wavetpu.obs import ledger as _ledger_mod
+
+                        n_dev = len(jax.devices())
+                        done = skipped = failed = 0
+                        for raw in warmup_manifest.get("keys", ()):
+                            try:
+                                pk = _ledger_mod.program_key_from_dict(
+                                    raw
+                                )
+                                if pk.mesh is not None and (
+                                    pk.mesh[0] * pk.mesh[1] * pk.mesh[2]
+                                    > n_dev
+                                ):
+                                    skipped += 1
+                                    continue
+                                mp = Problem(
+                                    N=pk.N, Np=1, Lx=pk.Lx, Ly=pk.Ly,
+                                    Lz=pk.Lz, T=pk.T,
+                                    timesteps=pk.timesteps,
+                                )
+                                if state.engine.program(
+                                    mp, pk.scheme, pk.path, pk.k,
+                                    pk.dtype, pk.with_field, pk.batch,
+                                    pk.mesh,
+                                ) is not None:
+                                    done += 1
+                                else:
+                                    skipped += 1
+                            except Exception as e:
+                                failed += 1
+                                print(f"manifest warmup key failed: "
+                                      f"{e}", file=sys.stderr)
+                        print(
+                            f"manifest warmup: {done} warmed, "
+                            f"{skipped} skipped, {failed} failed"
+                        )
+                        if failed:
+                            state.warmup_error = (
+                                f"{failed} manifest key(s) failed"
+                            )
                 except Exception as e:
                     state.warmup_error = str(e)
                     print(f"warmup failed: {e}", file=sys.stderr)

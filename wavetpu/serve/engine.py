@@ -14,6 +14,18 @@ of the least-recently-used on overflow, hits/misses/evictions counted for
 /metrics.  `warmup()` AOT-compiles ahead of traffic so the first request
 of a bucket does not pay the XLA compile.
 
+With `--program-cache-dir` set, a DISK tier (serve/progcache.py) sits
+between the memory LRU and a fresh compile: memory miss -> try
+adopting a persisted serialized executable (counted `disk_hit`, the
+saved seconds credited in the registry and the compile ledger as
+`source: disk`) -> else a fresh XLA compile (counted `miss`, recorded
+`source: fresh`, and persisted for the next process).  `miss` therefore
+still counts exactly the fresh compiles - the loadgen gate's
+"second replica compiled nothing" assertion reads it unchanged.  Disk
+problems (corrupt entries, stale fingerprints, full disk) are counted
+misses that fall through to a fresh compile - never a request failure,
+never a circuit-breaker feed.
+
 Every batch passes the per-lane numerical-health watchdog (the same
 guarded-amax reduction as run/health.py): a poisoned lane - NaN, Inf, or
 amplitude blowup from e.g. a Courant-unstable request - yields a per-lane
@@ -107,6 +119,8 @@ class ServeEngine:
         breaker_threshold: Optional[int] = 3,
         breaker_cooldown_s: float = 30.0,
         fault_plan: Optional[faults.ServeFaultPlan] = None,
+        program_cache_dir: Optional[str] = None,
+        program_cache_max_bytes: Optional[int] = None,
     ):
         if not bucket_sizes or any(b < 1 for b in bucket_sizes):
             raise ValueError(f"bad bucket_sizes {bucket_sizes}")
@@ -166,6 +180,20 @@ class ServeEngine:
         )
         if self.fault_plan is not None:
             self.fault_plan.bind_registry(self.registry)
+        # Persistent disk tier (serve/progcache.py): None without
+        # --program-cache-dir - every use is a None check, so the
+        # historical cacheless path is untouched.  A bad directory
+        # raises HERE (operator config error at startup), not
+        # per-request.
+        self.progcache = None
+        if program_cache_dir:
+            from wavetpu.serve import progcache as progcache_mod
+
+            self.progcache = progcache_mod.ProgramCache(
+                program_cache_dir,
+                max_bytes=program_cache_max_bytes,
+                registry=self.registry, fault_plan=self.fault_plan,
+            )
 
     # Cache hit/miss/eviction counts live in the registry counter - the
     # single source of truth for the JSON and Prometheus /metrics views;
@@ -182,6 +210,10 @@ class ServeEngine:
     @property
     def evictions(self) -> int:
         return int(self._c_cache.value(event="eviction"))
+
+    @property
+    def disk_hits(self) -> int:
+        return int(self._c_cache.value(event="disk_hit"))
 
     @property
     def max_batch(self) -> int:
@@ -229,13 +261,17 @@ class ServeEngine:
         dtype_name: str, with_field: bool, batch: int,
         mesh: Optional[Tuple[int, int, int]] = None,
     ):
-        """`program()` plus THIS call's compile attribution - (prog,
-        missed, compile_seconds).  The bool is what warm-vs-cold execute
-        attribution keys on; diffing the shared `misses` counter instead
-        would race with a concurrent warmup taking a miss on a
-        different key.  `compile_seconds` is 0.0 on a hit or fallback
-        and the measured build+compile wall time on a miss - the
-        `compile` component of the response's Server-Timing header."""
+        """`program()` plus THIS call's program-source attribution -
+        (prog, source, compile_seconds) with source one of "memory"
+        (LRU hit), "disk" (persistent-cache adoption), "fresh" (paid
+        the XLA compile), or "fallback" (prog is None - capability-
+        refused, the caller runs the lane loop).  Per-call state, not a
+        counter diff - diffing the shared `misses` counter would race
+        with a concurrent warmup taking a miss on a different key.
+        `compile_seconds` is 0.0 on a memory hit or fallback, the
+        deserialize wall on a disk hit, and the measured build+compile
+        wall on a fresh compile - the `compile` component of the
+        response's Server-Timing header."""
         compute_errors = self.compute_errors and not with_field
         if mesh is not None:
             if scheme != "standard":
@@ -254,7 +290,7 @@ class ServeEngine:
                 self.fallbacks.setdefault(
                     f"mesh:{tuple(mesh)}:{path}", why
                 )
-                return None, False, 0.0
+                return None, "fallback", 0.0
         else:
             ok, why = ensemble.vmap_capability(
                 path, k=k, interpret=self.interpret,
@@ -262,7 +298,7 @@ class ServeEngine:
             )
             if not ok:
                 self.fallbacks.setdefault(f"{scheme}:{path}", why)
-                return None, False, 0.0
+                return None, "fallback", 0.0
         key = ProgramKey.for_batch(
             problem, scheme, path, k, dtype_name, with_field,
             compute_errors, batch, mesh,
@@ -272,8 +308,67 @@ class ServeEngine:
             if prog is not None:
                 self._programs.move_to_end(key)
                 self._c_cache.inc(event="hit")
-                return prog, False, 0.0
-            self._c_cache.inc(event="miss")
+                return prog, "memory", 0.0
+
+        def _build():
+            if mesh is not None:
+                return ens_sharded.ShardedEnsembleSolver(
+                    problem, batch, mesh, dtype=self._dtype(dtype_name),
+                    kernel=path, compute_errors=compute_errors,
+                    interpret=self.interpret,
+                )
+            return ensemble.EnsembleSolver(
+                problem, batch, dtype=self._dtype(dtype_name),
+                path=path, k=k, compute_errors=compute_errors,
+                interpret=self.interpret, block_x=self.block_x,
+                with_field=with_field, scheme=scheme,
+            )
+
+        # Disk tier: adopt a persisted serialized executable before
+        # paying a fresh compile.  A valid entry counts `disk_hit` ONLY
+        # (not `miss` - `miss` stays exactly the fresh-compile count);
+        # ANY disk problem falls through to the fresh path as a normal
+        # miss.  The ledger gets a `source: disk` line whose compile_s
+        # is the deserialize wall and whose fresh_compile_s is the
+        # compile the entry replaced - the measured-savings record.
+        key_dict = None
+        if self.progcache is not None and self.progcache.usable:
+            key_dict = compile_ledger.key_from_program_key(key)
+            entry = self.progcache.load(key_dict)
+            if entry is not None:
+                payload, header = entry
+                t0 = time.perf_counter()
+                try:
+                    prog = _build()
+                    prog.adopt_executable(payload)
+                except Exception:
+                    # A checksum-valid entry whose payload this runtime
+                    # refuses (the fingerprint net has a hole): counted,
+                    # then the fresh path below pays the compile.
+                    self.progcache.count("corrupt")
+                    prog = None
+                if prog is not None:
+                    load_s = time.perf_counter() - t0
+                    self._c_cache.inc(event="disk_hit")
+                    fresh_s = header.get("compile_s")
+                    if isinstance(fresh_s, (int, float)):
+                        self.progcache.credit_saved(fresh_s, load_s)
+                    compile_ledger.record_compile(
+                        key_dict, load_s, source="disk",
+                        fresh_compile_s=(
+                            fresh_s
+                            if isinstance(fresh_s, (int, float))
+                            else None
+                        ),
+                    )
+                    with self._lock:
+                        self._programs[key] = prog
+                        self._programs.move_to_end(key)
+                        while len(self._programs) > self.max_programs:
+                            self._programs.popitem(last=False)
+                            self._c_cache.inc(event="eviction")
+                    return prog, "disk", load_s
+        self._c_cache.inc(event="miss")
         # Chaos seam: an injected compile failure lands exactly where a
         # real Mosaic/XLA build error would - after the miss is counted,
         # before any build work.
@@ -292,19 +387,18 @@ class ServeEngine:
             "serve.compile", scheme=scheme, path=path, batch=batch,
             n=problem.N, mesh=None if mesh is None else list(mesh),
         ):
-            if mesh is not None:
-                prog = ens_sharded.ShardedEnsembleSolver(
-                    problem, batch, mesh, dtype=self._dtype(dtype_name),
-                    kernel=path, compute_errors=compute_errors,
-                    interpret=self.interpret,
-                )
+            prog = _build()
+            if (
+                self.progcache is not None
+                and self.progcache.xla_hits is not None
+            ):
+                # XLA-fallback mode: the persistent compilation cache
+                # serves transparently inside compile(); sample its hit
+                # counter around the compile so the ledger still says
+                # where the time (didn't) go.
+                pre_hits = self.progcache.xla_hits.hits
             else:
-                prog = ensemble.EnsembleSolver(
-                    problem, batch, dtype=self._dtype(dtype_name), path=path,
-                    k=k, compute_errors=compute_errors,
-                    interpret=self.interpret, block_x=self.block_x,
-                    with_field=with_field, scheme=scheme,
-                )
+                pre_hits = None
             prog.compile()
         compile_seconds = time.perf_counter() - t0
         self._h_compile.observe(compile_seconds)
@@ -313,16 +407,48 @@ class ServeEngine:
         # restarts - the raw material for `wavetpu ledger-report`'s
         # cross-restart accounting and warmup manifest.  A None-check
         # no-op (zero file I/O) when no --telemetry-dir configured it.
-        compile_ledger.record_compile(
-            compile_ledger.key_from_program_key(key), compile_seconds
+        source = "fresh"
+        xla_served = (
+            pre_hits is not None
+            and self.progcache.xla_hits.hits > pre_hits
         )
+        if xla_served:
+            # The XLA persistent cache served this compile.  In
+            # fallback mode that IS the disk tier, so the ledger says
+            # so; in AOT mode the request still paid a (fast) compile
+            # call, no program was adopted, and the label stays fresh -
+            # `warm: disk` must always mean an adoption.
+            self.progcache.count("xla_hit")
+            if self.progcache.xla_fallback:
+                source = "disk"
+        compile_ledger.record_compile(
+            key_dict if key_dict is not None
+            else compile_ledger.key_from_program_key(key),
+            compile_seconds, source=source,
+        )
+        # Persist for the next process (AOT mode only; guarded - a full
+        # disk must never fail the request that just compiled).  Never
+        # from an xla-served compile: serializing a cache-served
+        # executable yields a payload that cannot deserialize.
+        if (
+            not xla_served
+            and self.progcache is not None and self.progcache.usable
+        ):
+            try:
+                payload = prog.executable_payload()
+                if payload is not None:
+                    self.progcache.put(
+                        key_dict, payload, compile_seconds
+                    )
+            except Exception:
+                self.progcache.count("store_error")
         with self._lock:
             self._programs[key] = prog
             self._programs.move_to_end(key)
             while len(self._programs) > self.max_programs:
                 self._programs.popitem(last=False)
                 self._c_cache.inc(event="eviction")
-        return prog, True, compile_seconds
+        return prog, "fresh", compile_seconds
 
     def warmup(
         self, problem: Problem, scheme: str = "standard",
@@ -367,9 +493,18 @@ class ServeEngine:
                 "max_programs": self.max_programs,
                 "hits": self.hits,
                 "misses": self.misses,
+                "disk_hits": self.disk_hits,
                 "evictions": self.evictions,
                 "keys": [list(k) for k in self._programs],
                 "fallbacks": dict(self.fallbacks),
+                # Disk tier (serve/progcache.py): entry count/bytes,
+                # event counts, and the once-per-process AOT
+                # serialization probe verdict.
+                "progcache": (
+                    self.progcache.stats()
+                    if self.progcache is not None
+                    else {"enabled": False}
+                ),
                 # Every cached vmap-capability verdict (single-device +
                 # sharded): a chip silently serving lane-loop is visible
                 # from the outside via these.
@@ -475,17 +610,22 @@ class ServeEngine:
             # jax-cache-dependent - its own label value, so fallback
             # outliers never pollute either the warm or the cold
             # batched population.
-            prog, missed, compile_seconds = self._program(
+            prog, source, compile_seconds = self._program(
                 problem, scheme, path, k, dtype_name, with_field, bucket,
                 mesh
             )
-            warm = prog is not None and not missed
+            warm = prog is not None and source == "memory"
+            # "disk" is its own label: a persistent-cache adoption pays
+            # deserialize (ms) where a cold compile pays XLA (s) - the
+            # two populations must not share a histogram bucket.
+            warm_label = (
+                "fallback" if prog is None
+                else "true" if warm
+                else "disk" if source == "disk" else "false"
+            )
             if timing is not None:
                 timing["compile_seconds"] = compile_seconds
-                timing["warm"] = (
-                    "fallback" if prog is None
-                    else "true" if warm else "false"
-                )
+                timing["warm"] = warm_label
             with tracing.span(
                 "serve.execute", scheme=scheme, path=path,
                 occupancy=len(lanes), bucket=bucket, warm=warm,
@@ -556,13 +696,7 @@ class ServeEngine:
             raise
         if self.breaker is not None:
             self.breaker.record_success(bkey)
-        self._h_execute.observe(
-            result.solve_seconds,
-            warm=(
-                "fallback" if prog is None
-                else "true" if warm else "false"
-            ),
-        )
+        self._h_execute.observe(result.solve_seconds, warm=warm_label)
         if not result.batched and result.fallback_reason:
             self.fallbacks.setdefault(
                 f"{scheme}:{result.path}", result.fallback_reason
