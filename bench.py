@@ -751,6 +751,124 @@ def _cold_start_row(interp):
         return {"error": "failed; see stderr"}
 
 
+def _fleet_row(interp):
+    """The router hop priced + the affinity proof.  Arm 1: a warmed
+    replica replayed DIRECT, then the identical trace through a
+    single-member `wavetpu router` fronting it - the p95 delta is the
+    pure proxy cost (one localhost hop + header forwarding), bar
+    <= 10%.  Arm 2: a two-member fleet behind the router, replayed
+    cold-start - the affinity table's hit rate (warm keys landed on
+    their holder) and the per-replica occupancy spread come from the
+    router's own /metrics snapshot.  Spread is |a - b| / total proxied:
+    ~1.0 means affinity pinned the whole mix to one holder (single
+    program identity), lower means the tier mix actually sharded."""
+    import threading
+    import traceback
+
+    from wavetpu.fleet.router import build_router
+    from wavetpu.loadgen import report as lg_report
+    from wavetpu.loadgen import runner, trace
+    from wavetpu.serve.api import build_server
+
+    n, steps, kernel = (8, 6, "roll") if interp else (64, 20, "auto")
+    scenarios = trace.default_scenarios(n=n, timesteps=steps)
+    records = trace.generate(
+        "poisson", duration=3.0, qps=6.0, scenarios=scenarios, seed=23
+    )
+
+    def serve():
+        httpd, state = build_server(
+            port=0, max_wait=0.02, default_kernel=kernel,
+            interpret=interp,
+        )
+        th = threading.Thread(target=httpd.serve_forever, daemon=True)
+        th.start()
+        return httpd, state, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    def front(member_urls):
+        rh, rs = build_router(member_urls, poll_interval_s=0.5)
+        threading.Thread(target=rh.serve_forever, daemon=True).start()
+        return rh, rs, f"http://127.0.0.1:{rh.server_address[1]}"
+
+    def run(base, warmup):
+        res = runner.replay(base, records, mode="closed",
+                            concurrency=4, warmup=warmup, timeout=1800)
+        return lg_report.build_report(res, target=base)
+
+    try:
+        h1, s1, u1 = serve()
+        h2, s2, u2 = serve()
+        try:
+            run(u1, warmup=len(scenarios))  # warm every tier + bucket
+            rep_direct = run(u1, warmup=0)
+            rh, rs, ru = front([u1])
+            try:
+                rep_router = run(ru, warmup=0)
+            finally:
+                rs.stop_poller()
+                rh.shutdown()
+                rh.server_close()
+            # Arm 2: the two-member fleet, from cold - warmup lands
+            # each tier per the cold-path p2c pick, the poller learns
+            # the warm tables, and the measured replay rides affinity.
+            rh, rs, ru = front([u1, u2])
+            try:
+                run(ru, warmup=len(scenarios))
+                rs.table.poll_once()
+                rep_fleet = run(ru, warmup=0)
+                snap = rs.snapshot()
+            finally:
+                rs.stop_poller()
+                rh.shutdown()
+                rh.server_close()
+        finally:
+            for h, s in ((h1, s1), (h2, s2)):
+                h.shutdown()
+                s.batcher.close()
+                h.server_close()
+        p95_direct = rep_direct["latency_ms"]["p95_ms"]
+        p95_router = rep_router["latency_ms"]["p95_ms"]
+        aff = snap["affinity"]
+        proxied = {
+            m["url"]: m.get("proxied_total", 0)
+            for m in snap["members"]
+        }
+        total = sum(proxied.values())
+        spread = (
+            round(abs(proxied.get(u1, 0) - proxied.get(u2, 0))
+                  / total, 3) if total else None
+        )
+        return {
+            "requests": rep_router["requests"],
+            "direct_p95_ms": p95_direct,
+            "router_p95_ms": p95_router,
+            "router_overhead_p95_pct": round(
+                100.0 * (p95_router - p95_direct) / p95_direct, 2
+            ) if p95_direct else None,
+            "fleet_p95_ms": rep_fleet["latency_ms"]["p95_ms"],
+            "fleet_error_rate": rep_fleet["error_rate"],
+            "affinity_hit_rate": aff.get("hit_rate"),
+            "affinity_decisions": {
+                k: aff.get(k) for k in
+                ("hits", "rerouted", "cold", "unkeyed")
+            },
+            "per_replica_proxied": proxied,
+            "occupancy_spread": spread,
+            "policy": "best_of_1",
+            "config": (
+                f"poisson mix {len(records)} reqs, closed loop c=4, "
+                f"N={n}/{steps} kernel={kernel}; arm1 = warmed direct "
+                f"vs router[1 member], bar <= 10% p95; arm2 = "
+                f"router[2 members] cold, affinity hit rate + "
+                f"|a-b|/total proxied spread from router /metrics"
+            ),
+        }
+    except Exception:
+        print("fleet sub-benchmark failed:", file=sys.stderr)
+        traceback.print_exc()
+        return {"error": "failed; see stderr"}
+
+
 def _occupancy_sweep(interp):
     """Batch-occupancy vs max_wait: the tail-latency/occupancy knob
     measured.  8 requests arrive ~10 ms apart at a max_batch=8 batcher;
@@ -1133,6 +1251,10 @@ def main() -> int:
     # pre-populated persistent program cache (subprocess arms,
     # best-of-2); the restart/autoscale win, bar >= 50% savings.
     subs["cold_start"] = _cold_start_row(interp)
+    # Fleet tier: router proxy-hop overhead (direct vs router-fronted,
+    # <= 10% p95 bar) and ProgramKey-affinity hit rate + per-replica
+    # spread over a two-member fleet.
+    subs["fleet"] = _fleet_row(interp)
     line = {
         "metric": "gcell_updates_per_s",
         "value": head["gcells_per_s"],
@@ -1211,6 +1333,15 @@ def main() -> int:
         ),
         "cold_start_savings_pct": subs["cold_start"].get(
             "savings_pct"
+        ),
+        "fleet_router_overhead_p95_pct": subs["fleet"].get(
+            "router_overhead_p95_pct"
+        ),
+        "fleet_affinity_hit_rate": subs["fleet"].get(
+            "affinity_hit_rate"
+        ),
+        "fleet_occupancy_spread": subs["fleet"].get(
+            "occupancy_spread"
         ),
         "headline_summary": True,
     }

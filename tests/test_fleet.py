@@ -1,0 +1,826 @@
+"""Fleet-tier contracts: the shared ProgramKey derivation (router ==
+engine, pinned so they cannot drift), health-gated membership, the
+warm-key affinity table, the router proxy seam (scripted members: no
+jax), drain-during-inflight absorption (real two-replica fleet +
+WAVETPU_FAULT chaos at one member), and the rolling-deploy acceptance
+drill (closed-loop replay through the router while one replica is
+rolled: zero client-visible errors, zero fresh compiles, >= 90%% of
+warm-key requests landing on a holder).
+"""
+
+import json
+import random
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from wavetpu import progkey
+from wavetpu.client import WavetpuClient
+from wavetpu.fleet.affinity import (
+    AffinityTable,
+    warm_label_from_server_timing,
+)
+from wavetpu.fleet.membership import (
+    EJECTED,
+    JOINING,
+    LEAVING,
+    LEFT,
+    UP,
+    MembershipTable,
+)
+from wavetpu.fleet.router import build_router
+from wavetpu.fleet import roll as fleet_roll
+from wavetpu.loadgen import report as lg_report
+from wavetpu.loadgen import runner, trace
+from wavetpu.run import faults
+from wavetpu.serve.api import build_server, parse_solve_request
+
+
+# ---- the shared key derivation: router == engine, pinned ----
+
+
+class TestSharedKeyDerivation:
+    BODIES = [
+        {"N": 8, "timesteps": 4},
+        {"N": 8, "timesteps": 4, "phase": 1.0},   # same identity
+        {"N": 12, "timesteps": 6, "Lx": "pi", "dtype": "f64"},
+        {"N": 8, "timesteps": 4, "scheme": "compensated"},
+        {"N": 8, "timesteps": 4, "kernel": "pallas", "fuse_steps": 2},
+        {"N": 8, "timesteps": 4, "c2_field": "gaussian-lens"},
+        {"N": 8, "timesteps": 4, "mesh": [1, 1, 2]},
+    ]
+
+    def test_router_identity_matches_engine_program_key(self):
+        """THE drift pin: for every body shape the fleet serves, the
+        affinity key the router derives (progkey.identity_from_body,
+        no jax) equals the affinity projection of the ProgramKey the
+        engine actually caches under (parse_solve_request -> the
+        engine's for_batch key)."""
+        for body in self.BODIES:
+            ident = progkey.identity_from_body(body, platform="cpu")
+            req = parse_solve_request(body)
+            engine_key = progkey.ProgramKey.for_batch(
+                req.problem, req.scheme, req.path, req.k,
+                req.dtype_name,
+                with_field=req.lane.c2tau2_field is not None,
+                compute_errors=True, batch=4, mesh=req.mesh_shape,
+            )
+            assert ident.affinity_key() == progkey.affinity_key(
+                engine_key
+            ), body
+
+    def test_affinity_key_ignores_batch_and_compute_errors(self):
+        ident = progkey.identity_from_body(
+            {"N": 8, "timesteps": 4}, platform="cpu"
+        )
+        keys = {
+            progkey.affinity_key(ident.program_key(b, ce))
+            for b in (1, 2, 4, 8) for ce in (True, False)
+        }
+        assert keys == {ident.affinity_key()}
+
+    def test_identity_rejects_what_the_server_rejects(self):
+        for body in (
+            {"timesteps": 4},                       # missing N
+            {"N": 8, "scheme": "magic"},
+            {"N": 8, "dtype": "f16"},
+            {"N": 8, "fuse_steps": 2, "kernel": "roll"},
+            {"N": 8, "scheme": "compensated", "dtype": "bf16"},
+            {"N": 8, "mesh": [2, 2]},
+            {"N": 8, "mesh": [1, 1, 2], "fuse_steps": 2,
+             "kernel": "pallas"},
+        ):
+            with pytest.raises(ValueError):
+                progkey.identity_from_body(body, platform="cpu")
+
+    def test_warm_keys_flatten_dedup_and_skip_malformed(self):
+        kd = progkey.key_from_program_key(
+            progkey.identity_from_body(
+                {"N": 8, "timesteps": 4}, platform="cpu"
+            ).program_key(4, True)
+        )
+        other = dict(kd, batch=8)           # same tier, other bucket
+        warm = {
+            "memory": [kd, "junk", None],
+            "disk": [other, {"not": "a key"}],
+        }
+        aks = progkey.warm_keys_to_affinity(warm)
+        assert aks == [progkey.affinity_key_from_dict(kd)]
+
+    def test_warm_label_parse(self):
+        h = ("queue;dur=1.2, compile;dur=0.0, execute;dur=45, "
+             "warm;desc=disk, total;dur=50")
+        assert warm_label_from_server_timing(h) == "disk"
+        assert warm_label_from_server_timing("execute;dur=4") is None
+        assert warm_label_from_server_timing(None) is None
+
+
+# ---- membership state machine (fake transport, zero sockets) ----
+
+
+class _FakeFleet:
+    """Scriptable fetch: per-url healthz/metrics payloads or raised
+    transport errors."""
+
+    def __init__(self):
+        self.health = {}     # url -> dict | Exception
+        self.prom = {}       # url -> str
+        self.warm = {}       # url -> warm_keys dict
+
+    def fetch(self, base_url, path, timeout, accept=None):
+        url = base_url.rstrip("/")
+        if path == "/healthz":
+            h = self.health.get(url, ConnectionRefusedError("down"))
+            if isinstance(h, Exception):
+                raise h
+            return 200, json.dumps(h)
+        if path == "/metrics":
+            h = self.health.get(url)
+            if isinstance(h, Exception) or h is None:
+                raise ConnectionRefusedError("down")
+            if accept == "application/json":
+                return 200, json.dumps({
+                    "queue_depth": 0,
+                    "program_cache": {
+                        "warm_keys": self.warm.get(url, {}),
+                    },
+                })
+            return 200, self.prom.get(url, "")
+        raise AssertionError(f"unexpected path {path}")
+
+
+READY = {"status": "ok", "ready": True, "backend": "cpu"}
+DRAINING = {"status": "ok", "ready": False, "draining": True}
+
+
+class TestMembership:
+    def _table(self, urls, **kw):
+        fleet = _FakeFleet()
+        for u in urls:
+            fleet.health[u] = dict(READY)
+        table = MembershipTable(urls, fetch=fleet.fetch, **kw)
+        return fleet, table
+
+    def test_joining_to_up_on_ready(self):
+        fleet, table = self._table(["http://a:1"])
+        assert table.get("http://a:1").state == JOINING
+        table.poll_once()
+        assert table.get("http://a:1").state == UP
+        assert table.routable_urls() == ["http://a:1"]
+
+    def test_ready_false_ejects_immediately_and_readmits(self):
+        fleet, table = self._table(["http://a:1"])
+        table.poll_once()
+        fleet.health["http://a:1"] = dict(DRAINING)
+        table.poll_once()
+        m = table.get("http://a:1")
+        assert m.state == EJECTED and not table.routable_urls()
+        fleet.health["http://a:1"] = dict(READY)
+        table.poll_once()
+        assert m.state == UP  # recovery re-admits, no operator action
+
+    def test_transport_failures_eject_at_threshold_only(self):
+        fleet, table = self._table(["http://a:1"], fail_threshold=3)
+        table.poll_once()
+        fleet.health["http://a:1"] = ConnectionRefusedError("boom")
+        table.poll_once()
+        table.poll_once()
+        assert table.get("http://a:1").state == UP  # 2 < threshold
+        table.poll_once()
+        assert table.get("http://a:1").state == EJECTED
+        fleet.health["http://a:1"] = dict(READY)
+        table.poll_once()
+        m = table.get("http://a:1")
+        assert m.state == UP and m.consecutive_failures == 0
+
+    def test_leave_retire_freezes_counters_for_aggregation(self):
+        fleet, table = self._table(["http://a:1", "http://b:2"])
+        fleet.prom["http://a:1"] = "wavetpu_x_total 5\n"
+        fleet.prom["http://b:2"] = "wavetpu_x_total 7\n"
+        table.poll_once()
+        assert table.aggregate_prom(refresh=False) == {
+            "wavetpu_x_total": 12.0
+        }
+        table.leave("http://a:1")
+        assert table.get("http://a:1").state == LEAVING
+        assert table.routable_urls() == ["http://b:2"]
+        table.retire("http://a:1")
+        assert table.get("http://a:1").state == LEFT
+        # a is gone from the network...
+        fleet.health["http://a:1"] = ConnectionRefusedError("gone")
+        fleet.prom["http://b:2"] = "wavetpu_x_total 9\n"
+        table.poll_once()
+        # ...but its final counters stay in the sum: monotonic deltas
+        # across a roll.
+        assert table.aggregate_prom(refresh=False) == {
+            "wavetpu_x_total": 14.0
+        }
+
+    def test_join_baseline_excludes_prejoin_history(self):
+        """A member admitted mid-flight (the /admin/join path) must
+        contribute only growth SINCE join to the fleet aggregate - its
+        manifest-warmup compiles happened before it was fleet."""
+        fleet, table = self._table(["http://a:1"])
+        fleet.prom["http://a:1"] = "wavetpu_x_total 5\n"
+        table.poll_once()
+        # the successor arrives carrying 3 pre-join compiles and a
+        # nonzero gauge
+        fleet.health["http://b:2"] = dict(READY)
+        fleet.prom["http://b:2"] = (
+            "wavetpu_x_total 3\nwavetpu_gauge 2\n"
+        )
+        m = table.add("http://b:2", baseline=True)
+        table.poll_member(m)
+        agg = table.aggregate_prom(refresh=False)
+        # counter baselined away; the gauge passes through absolute
+        assert agg["wavetpu_x_total"] == 5.0
+        assert agg["wavetpu_gauge"] == 2.0
+        # growth after join counts
+        fleet.prom["http://b:2"] = (
+            "wavetpu_x_total 4\nwavetpu_gauge 0\n"
+        )
+        table.poll_once()
+        agg = table.aggregate_prom(refresh=False)
+        assert agg["wavetpu_x_total"] == 6.0
+        assert agg["wavetpu_gauge"] == 0.0
+
+    def test_poll_feeds_affinity_warm_keys(self):
+        aff = AffinityTable(rng=random.Random(0))
+        fleet = _FakeFleet()
+        fleet.health["http://a:1"] = dict(READY)
+        kd = progkey.key_from_program_key(
+            progkey.identity_from_body(
+                {"N": 8, "timesteps": 4}, platform="cpu"
+            ).program_key(4, True)
+        )
+        fleet.warm["http://a:1"] = {"memory": [kd], "disk": []}
+        table = MembershipTable(
+            ["http://a:1"], fetch=fleet.fetch, affinity=aff
+        )
+        table.poll_once()
+        ak = progkey.affinity_key_from_dict(kd)
+        assert aff.holders(ak) == {"http://a:1"}
+        assert table.get("http://a:1").warm_key_count == 1
+
+
+# ---- affinity table ----
+
+
+class TestAffinityTable:
+    AK1, AK2 = '{"k": 1}', '{"k": 2}'
+
+    def test_poll_replace_and_response_add(self):
+        t = AffinityTable(rng=random.Random(0))
+        t.observe_response("http://a", self.AK1, "false")  # just compiled
+        t.observe_response("http://a", self.AK2, "fallback")  # no program
+        assert t.holders(self.AK1) == {"http://a"}
+        assert t.holders(self.AK2) == set()
+        # poll REPLACES a's set; response-learned key not in the poll
+        # is dropped (evicted server-side)
+        t.observe_response("http://b", self.AK1, "disk")
+        t.observe_warm_keys("http://a", {"memory": [], "disk": []})
+        assert t.holders(self.AK1) == {"http://b"}
+
+    def test_choose_counts_hit_rerouted_cold_unkeyed(self):
+        t = AffinityTable(rng=random.Random(0))
+        load = lambda u: 0.0  # noqa: E731
+        t.observe_response("http://a", self.AK1, "true")
+        assert t.choose(self.AK1, ["http://a", "http://b"], load) \
+            == "http://a"
+        # holder exists but is not a candidate (ejected): rerouted
+        assert t.choose(self.AK1, ["http://b"], load) == "http://b"
+        t.choose(self.AK2, ["http://a", "http://b"], load)   # cold
+        t.choose(None, ["http://a"], load)                   # unkeyed
+        s = t.stats()
+        assert (s["hits"], s["rerouted"], s["cold"], s["unkeyed"]) \
+            == (1, 1, 1, 1)
+        assert s["hit_rate"] == 0.5
+
+    def test_p2c_prefers_lower_load(self):
+        t = AffinityTable(rng=random.Random(42))
+        loads = {"http://a": 9.0, "http://b": 0.0}
+        picks = {
+            t.choose(None, ["http://a", "http://b"], loads.get)
+            for _ in range(16)
+        }
+        assert picks == {"http://b"}  # both sampled each time: 2 of 2
+
+    def test_forget_member(self):
+        t = AffinityTable(rng=random.Random(0))
+        t.observe_response("http://a", self.AK1, "true")
+        t.forget_member("http://a")
+        assert t.holders(self.AK1) == set()
+        assert t.known_keys() == 0
+
+
+# ---- scripted members: the router proxy seam without jax ----
+
+
+class _ScriptedMember:
+    """A fake replica speaking the serve contract's fleet-facing
+    subset: /healthz, /metrics (JSON + Prometheus), /solve (scripted
+    or default-200 with a warm label), /admin/drain."""
+
+    def __init__(self, warm_keys=None, prom="wavetpu_y_total 1\n"):
+        self.lock = threading.Lock()
+        self.ready = True
+        self.draining = False
+        self.warm_keys = warm_keys or {"memory": [], "disk": []}
+        self.prom = prom
+        self.solve_script = []   # (status, payload, headers) or "drop"
+        self.solves = 0
+
+        state = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code, payload, headers=None,
+                      content_type="application/json"):
+                raw = (payload if isinstance(payload, bytes)
+                       else json.dumps(payload).encode())
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(raw)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(raw)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    with state.lock:
+                        self._send(200, {
+                            "status": "ok",
+                            "ready": state.ready and not state.draining,
+                            "draining": state.draining,
+                            "backend": "cpu",
+                        })
+                elif self.path == "/metrics":
+                    accept = self.headers.get("Accept", "") or ""
+                    if "application/json" in accept:
+                        with state.lock:
+                            self._send(200, {
+                                "queue_depth": 0,
+                                "program_cache": {
+                                    "warm_keys": state.warm_keys,
+                                },
+                            })
+                    else:
+                        with state.lock:
+                            self._send(
+                                200, state.prom.encode(),
+                                content_type="text/plain",
+                            )
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0) or 0)
+                self.rfile.read(length)
+                if self.path == "/admin/drain":
+                    with state.lock:
+                        state.draining = True
+                    self._send(200, {"status": "ok", "draining": True},
+                               {"Connection": "close"})
+                    return
+                with state.lock:
+                    state.solves += 1
+                    if state.draining:
+                        self._send(503, {
+                            "status": "error", "error": "draining",
+                            "retriable": True,
+                        }, {"Retry-After": "2", "Connection": "close"})
+                        return
+                    step = (state.solve_script.pop(0)
+                            if state.solve_script else None)
+                if step == "drop":
+                    self.close_connection = True
+                    self.connection.close()
+                    return
+                if step is not None:
+                    self._send(*step)
+                    return
+                self._send(200, {"status": "ok", "report": {}}, {
+                    "Server-Timing": "execute;dur=1, warm;desc=true",
+                })
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        ).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _start_router(member_urls, **kw):
+    kw.setdefault("poll_interval_s", 60.0)  # tests poll explicitly
+    kw.setdefault("rng", random.Random(0))
+    httpd, state = build_router(member_urls, **kw)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, state, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def _post(base, path, body, timeout=30):
+    import urllib.error
+
+    req = urllib.request.Request(
+        base + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _get(base, path, accept=None, timeout=30):
+    req = urllib.request.Request(
+        base + path, headers={"Accept": accept} if accept else {}
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+class TestRouterProxy:
+    BODY = {"N": 8, "timesteps": 4}
+
+    def _ak(self, body=None):
+        return progkey.identity_from_body(
+            body or self.BODY, platform="cpu"
+        ).affinity_key()
+
+    def test_routes_warm_key_to_advertised_holder(self):
+        """Bootstrap affinity: B advertises the key in its /metrics
+        warm_keys (disk inheritance); every request for it lands on B
+        even though A is equally healthy."""
+        kd = progkey.key_from_program_key(
+            progkey.identity_from_body(
+                self.BODY, platform="cpu"
+            ).program_key(4, True)
+        )
+        a = _ScriptedMember()
+        b = _ScriptedMember(warm_keys={"memory": [], "disk": [kd]})
+        httpd, state, base = _start_router([a.url, b.url])
+        try:
+            for _ in range(4):
+                code, _, headers = _post(base, "/solve", self.BODY)
+                assert code == 200
+                assert headers["X-Wavetpu-Member"] == b.url
+            assert a.solves == 0 and b.solves == 4
+            assert state.affinity.stats()["hits"] == 4
+        finally:
+            httpd.shutdown(); httpd.server_close()
+            state.stop_poller()
+            a.close(); b.close()
+
+    def test_response_warm_label_builds_affinity(self):
+        """No poll data at all: the first (cold) response's warm label
+        pins the key to whichever member served it."""
+        a, b = _ScriptedMember(), _ScriptedMember()
+        httpd, state, base = _start_router([a.url, b.url])
+        try:
+            _, _, headers = _post(base, "/solve", self.BODY)
+            first = headers["X-Wavetpu-Member"]
+            for _ in range(5):
+                _, _, h = _post(base, "/solve", self.BODY)
+                assert h["X-Wavetpu-Member"] == first
+            s = state.affinity.stats()
+            assert s["cold"] == 1 and s["hits"] == 5
+        finally:
+            httpd.shutdown(); httpd.server_close()
+            state.stop_poller()
+            a.close(); b.close()
+
+    def test_draining_503_retried_on_live_member_not_surfaced(self):
+        """Satellite: the cutover seam.  A drained member's 503 +
+        Retry-After is absorbed by the ROUTER (retried onto the live
+        member); a zero-retry client sees only 200s."""
+        kd = progkey.key_from_program_key(
+            progkey.identity_from_body(
+                self.BODY, platform="cpu"
+            ).program_key(4, True)
+        )
+        # a advertises the key -> every first pick deterministically
+        # lands on a, which is ALREADY draining (the router learns only
+        # at the next poll - exactly the cutover race).
+        a = _ScriptedMember(warm_keys={"memory": [kd], "disk": []})
+        b = _ScriptedMember()
+        # b's responses carry no warm label, so b never becomes a
+        # holder and every first pick keeps landing on (draining) a.
+        b.solve_script = [(200, {"status": "ok"}, {})] * 4
+        httpd, state, base = _start_router([a.url, b.url])
+        try:
+            a.draining = True
+            for _ in range(4):
+                code, payload, headers = _post(base, "/solve", self.BODY)
+                assert code == 200, payload
+                assert headers["X-Wavetpu-Member"] == b.url
+            snap = state.snapshot()
+            # every request first hit draining a, was retried onto b,
+            # and none failed
+            assert snap["exhausted_total"] == 0
+            assert snap["retried_requests"] == 4
+            assert a.solves == 4 and b.solves == 4
+        finally:
+            httpd.shutdown(); httpd.server_close()
+            state.stop_poller()
+            a.close(); b.close()
+
+    def test_connection_drop_retried_on_other_member(self):
+        kd = progkey.key_from_program_key(
+            progkey.identity_from_body(
+                self.BODY, platform="cpu"
+            ).program_key(4, True)
+        )
+        a = _ScriptedMember(warm_keys={"memory": [kd], "disk": []})
+        b = _ScriptedMember()
+        a.solve_script = ["drop"]  # first hit at holder a: severed conn
+        httpd, state, base = _start_router([a.url, b.url])
+        try:
+            for _ in range(3):
+                code, payload, _ = _post(base, "/solve", self.BODY)
+                assert code == 200, payload
+            assert state.snapshot()["retried_requests"] >= 1
+            assert a.solves >= 1 and b.solves >= 1
+        finally:
+            httpd.shutdown(); httpd.server_close()
+            state.stop_poller()
+            a.close(); b.close()
+
+    def test_all_members_down_yields_retriable_503(self):
+        a, b = _ScriptedMember(), _ScriptedMember()
+        a.draining = True
+        b.draining = True
+        httpd, state, base = _start_router([a.url, b.url])
+        try:
+            code, payload, headers = _post(base, "/solve", self.BODY)
+            assert code == 503
+            assert payload.get("retriable") is True or \
+                "Retry-After" in headers
+            assert "Retry-After" in headers
+            assert state.snapshot()["exhausted_total"] == 1
+        finally:
+            httpd.shutdown(); httpd.server_close()
+            state.stop_poller()
+            a.close(); b.close()
+
+    def test_malformed_body_forwarded_replica_owns_the_400(self):
+        a = _ScriptedMember()
+        a.solve_script = [(400, {"status": "error",
+                                 "error": "missing required field N"},
+                           {})]
+        httpd, state, base = _start_router([a.url])
+        try:
+            code, payload, _ = _post(base, "/solve", {"junk": True})
+            assert code == 400 and "missing" in payload["error"]
+            assert state.snapshot()["unparseable_total"] == 1
+        finally:
+            httpd.shutdown(); httpd.server_close()
+            state.stop_poller()
+            a.close()
+
+    def test_healthz_and_admin_join_leave(self):
+        a, b = _ScriptedMember(), _ScriptedMember()
+        httpd, state, base = _start_router([a.url])
+        try:
+            _, text = _get(base, "/healthz")
+            h = json.loads(text)
+            assert h["ready"] is True and h["members_up"] == 1
+            code, payload, _ = _post(base, "/admin/join", {"url": b.url})
+            assert code == 200
+            assert payload["member"]["state"] == "up"  # synchronous poll
+            _, text = _get(base, "/healthz")
+            assert json.loads(text)["members_up"] == 2
+            code, _, _ = _post(
+                base, "/admin/leave",
+                {"url": a.url, "sync": True, "drain_wait_s": 2.0},
+            )
+            assert code == 200
+            assert a.draining is True  # router POSTed /admin/drain
+            m = state.table.get(a.url)
+            assert m.state == LEFT
+            code, payload, _ = _post(base, "/admin/leave",
+                                     {"url": "http://nope:1"})
+            assert code == 404
+        finally:
+            httpd.shutdown(); httpd.server_close()
+            state.stop_poller()
+            a.close(); b.close()
+
+    def test_metrics_aggregation_monotonic_across_leave(self):
+        a = _ScriptedMember(prom="wavetpu_y_total 5\n")
+        b = _ScriptedMember(prom="wavetpu_y_total 3\n")
+        httpd, state, base = _start_router([a.url, b.url])
+        try:
+            _, text = _get(base, "/metrics", accept="text/plain")
+            samples = runner.parse_prometheus_text(text)
+            assert samples["wavetpu_y_total"] == 8.0
+            assert "wavetpu_router_requests_total" in samples
+            _post(base, "/admin/leave",
+                  {"url": a.url, "sync": True, "drain_wait_s": 1.0})
+            a.close()  # the process is gone
+            b.prom = "wavetpu_y_total 4\n"
+            _, text = _get(base, "/metrics", accept="text/plain")
+            samples = runner.parse_prometheus_text(text)
+            # a's final 5 frozen in, b refreshed to 4: still monotonic
+            assert samples["wavetpu_y_total"] == 9.0
+            assert samples['wavetpu_router_members{state="left"}'] == 1
+        finally:
+            httpd.shutdown(); httpd.server_close()
+            state.stop_poller()
+            b.close()
+
+    def test_json_metrics_expose_affinity_and_members(self):
+        a = _ScriptedMember()
+        httpd, state, base = _start_router([a.url])
+        try:
+            _post(base, "/solve", self.BODY)
+            _, text = _get(base, "/metrics")
+            snap = json.loads(text)
+            assert snap["router"] is True
+            assert set(snap["affinity"]) >= {
+                "hits", "rerouted", "cold", "hit_rate", "known_keys",
+            }
+            assert snap["members"][0]["proxied_total"] == 1
+        finally:
+            httpd.shutdown(); httpd.server_close()
+            state.stop_poller()
+            a.close()
+
+
+# ---- real fleet: chaos at one member, absorbed at the router seam ----
+
+
+def _start_replica(**kw):
+    kw.setdefault("max_wait", 0.02)
+    kw.setdefault("default_kernel", "roll")
+    kw.setdefault("interpret", True)
+    httpd, state = build_server(port=0, **kw)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, state, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def _stop_replica(httpd, state):
+    try:
+        httpd.shutdown()
+    except Exception:
+        pass
+    state.batcher.close(timeout=30.0, drain=False)
+    httpd.server_close()
+
+
+class TestFleetChaos:
+    def test_member_faults_absorbed_by_router_zero_retry_client(self):
+        """Satellite: WAVETPU_FAULT conn-drop + worker-crash specs at
+        ONE member of a two-replica fleet.  The router retries the
+        transport error and the crashed-worker 503 onto the live
+        member, so even a ZERO-retry client sees only 200s."""
+        plan = faults.parse_serve_spec(
+            "serve-conn-drop:after=1,count=1;"
+            "serve-worker-crash:after=1,count=1"
+        )
+        h1, s1, u1 = _start_replica(fault_plan=plan)
+        h2, s2, u2 = _start_replica()
+        httpd, state, base = _start_router(
+            [u1, u2], poll_interval_s=60.0, proxy_timeout=60.0
+        )
+        try:
+            # Warm u1 DIRECTLY (the after=1 budgets skip this request
+            # and its batch), then poll: u1 now advertises the key, so
+            # the router's first routed pick lands on the faulted
+            # member - the seam the chaos must cross.
+            direct = WavetpuClient(u1, retries=0, timeout=60.0)
+            assert direct.solve({"N": 8, "timesteps": 4}).ok
+            state.table.poll_once()
+            client = WavetpuClient(base, retries=0, timeout=60.0)
+            outs = []
+            for i in range(20):
+                # distinct phases dodge request coalescing; loop until
+                # both faults have fired through the router
+                outs.append(client.solve(
+                    {"N": 8, "timesteps": 4, "phase": 1.0 + i}
+                ))
+                fired = {
+                    s["kind"]: s["fired"] for s in plan.snapshot()
+                }
+                if (fired.get("conn-drop") and
+                        fired.get("worker-crash")):
+                    break
+            assert all(o.ok for o in outs), [
+                (o.status, o.error) for o in outs if not o.ok
+            ]
+            assert all(o.attempts == 1 for o in outs)  # zero retries
+            fired = {s["kind"]: s["fired"] for s in plan.snapshot()}
+            assert fired["conn-drop"] == 1
+            assert fired["worker-crash"] == 1
+            assert state.snapshot()["retried_requests"] >= 2
+        finally:
+            httpd.shutdown(); httpd.server_close()
+            state.stop_poller()
+            _stop_replica(h1, s1)
+            _stop_replica(h2, s2)
+
+
+# ---- acceptance: the rolling-deploy drill ----
+
+
+class TestRollingDeployDrill:
+    def test_roll_under_load_zero_errors_zero_cold_compiles(
+        self, tmp_path
+    ):
+        """ISSUE acceptance: closed-loop replay THROUGH THE ROUTER over
+        a two-replica fleet while one replica is rolled out and its
+        successor (sharing the persistent program cache) rolled in -
+        via the real `fleet roll` driver against the router's admin
+        API.  Asserts: zero client-visible errors, ZERO fresh compiles
+        in the replay window (--max-cold-compiles 0 equivalent on the
+        router-fronted report: the successor disk-adopts, never
+        recompiles), and >= 90%% of warm-key requests routed to a
+        holder (affinity hit rate from the router's /metrics)."""
+        cache_dir = str(tmp_path / "progcache")
+        # max_batch=1: closed-loop concurrency 3 would otherwise
+        # coalesce into bucket-2 programs the sequential warmup never
+        # compiled - a batcher first-contact cost, not a cutover cost.
+        # Pinning the bucket makes "zero fresh compiles" measure the
+        # roll alone.
+        rep_kw = dict(program_cache_dir=cache_dir, max_batch=1)
+        h1, s1, u1 = _start_replica(**rep_kw)
+        h2, s2, u2 = _start_replica(**rep_kw)
+        httpd, state, base = _start_router(
+            [u1, u2], poll_interval_s=0.3, proxy_timeout=120.0,
+        )
+        scenarios = [
+            {"name": "t4", "weight": 2, "body": {"N": 8, "timesteps": 4}},
+            {"name": "t6", "weight": 1, "body": {"N": 8, "timesteps": 6}},
+        ]
+        records = trace.generate(
+            "uniform", 4.0, 8.0, scenarios=scenarios, seed=11
+        )
+        h3 = s3 = None
+        roll_result = {}
+
+        def _roll():
+            nonlocal h3, s3
+            # the successor: same shared program cache -> every program
+            # the fleet compiled is a DISK ADOPTION, not a compile
+            h, s, u = _start_replica(**rep_kw)
+            h3, s3 = h, s
+            roll_result["url"] = u
+            roll_result["rc"] = fleet_roll.roll(
+                base, old_url=u1, new_url=u,
+                spawn_argv=None, manifest_path=None,
+                timeout_s=60.0, leave_sync=True,
+                log=lambda *a, **k: None,
+            )
+
+        roller = threading.Thread(target=_roll, daemon=True)
+        try:
+            # warmup=2: both tiers compiled + disk-persisted per the
+            # affinity-routed holder BEFORE the measured window
+            deadline = threading.Timer(1.0, roller.start)
+            deadline.start()
+            result = runner.replay(
+                base, records, mode="closed", concurrency=3,
+                warmup=2, timeout=120.0, retries=2, duration=10.0,
+            )
+            roller.join(90.0)
+            assert roll_result.get("rc") == 0, roll_result
+            report = lg_report.build_report(result, target=base)
+            # 1. zero client-visible errors across the cutover
+            assert report["errors"] == 0, report
+            # 2. zero fresh compiles in the replay window: the gate the
+            # CI smoke runs as --max-cold-compiles 0 --error-budget 0
+            violations = lg_report.gate(report, slo={
+                "error_budget": 0.0, "max_cold_compiles": 0,
+            })
+            assert violations == [], violations
+            # 3. affinity kept landing warm keys on holders (>= 90%)
+            aff = state.snapshot()["affinity"]
+            assert aff["hit_rate"] is not None
+            assert aff["hit_rate"] >= 0.90, aff
+            # 4. the roll really happened: predecessor retired, the
+            # successor served traffic
+            assert state.table.get(u1).state == LEFT
+            per_member = {
+                row["url"]: row["proxied_total"]
+                for row in state.snapshot()["members"]
+            }
+            assert per_member.get(roll_result["url"], 0) > 0, per_member
+        finally:
+            httpd.shutdown(); httpd.server_close()
+            state.stop_poller()
+            _stop_replica(h1, s1)
+            _stop_replica(h2, s2)
+            if h3 is not None:
+                _stop_replica(h3, s3)

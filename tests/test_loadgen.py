@@ -494,6 +494,90 @@ class TestSoakAndRetries:
         assert "-> " not in capsys.readouterr().out  # no gate ran
 
 
+# ---- multi-target fan-out (repeated --target) ----
+
+
+def _second_server():
+    httpd, state = build_server(
+        port=0, max_wait=0.02, default_kernel="roll", interpret=True,
+    )
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, state, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+class TestMultiTarget:
+    def test_round_robin_reaches_both_and_per_target_breakdown(
+        self, server
+    ):
+        """`replay([url1, url2])`: requests fan out round-robin, warmup
+        serves every tier at EVERY target, the bracketing /metrics cuts
+        are summed fleet-wide, and the report grows a per-target
+        breakdown."""
+        base1, _, _ = server
+        h2, s2, base2 = _second_server()
+        try:
+            records = trace.generate(
+                "uniform", 1.0, 6.0, scenarios=_mini_scenarios(), seed=5
+            )
+            res = runner.replay(
+                [base1, base2], records, mode="closed", concurrency=2,
+                warmup=2, timeout=300,
+            )
+            assert res.targets == [base1, base2]
+            assert {o.target for o in res.outcomes} == {base1, base2}
+            # warmup = one request per tier per TARGET (one replica
+            # warm is not the fleet warm)
+            assert len(res.warmup_outcomes) == 4
+            assert {o.target for o in res.warmup_outcomes} == \
+                {base1, base2}
+            # summed metrics cuts: the fleet-wide accepted-request
+            # counter grew by warmup + measured requests
+            name = "wavetpu_serve_requests_total"
+            grown = (res.metrics_after.get(name, 0.0)
+                     - res.metrics_before.get(name, 0.0))
+            assert grown == len(res.outcomes)
+            rep = lg_report.build_report(res, target=[base1, base2])
+            assert rep["targets"] == [base1, base2]
+            per = rep["per_target"]
+            assert set(per) == {base1, base2}
+            assert sum(r["requests"] for r in per.values()) == \
+                rep["requests"]
+            for row in per.values():
+                assert row["ok"] == row["requests"]
+                assert row["errors"] == 0
+                assert row["p95_ms"] >= 0.0
+        finally:
+            h2.shutdown()
+            s2.batcher.close()
+            h2.server_close()
+
+    def test_cli_repeated_target_flag(self, server, tmp_path, capsys):
+        base1, _, _ = server
+        h2, s2, base2 = _second_server()
+        try:
+            path = str(tmp_path / "t.jsonl")
+            trace.save_scenario_trace(path, trace.generate(
+                "uniform", 1.0, 4.0, scenarios=_mini_scenarios(),
+                seed=12,
+            ))
+            out = str(tmp_path / "rep.json")
+            assert loadgen_main([
+                "replay", path, "--target", base1, "--target", base2,
+                "--mode", "closed", "--concurrency", "2",
+                "--warmup", "2", "--out", out, "--timeout", "300",
+            ]) == 0
+            printed = capsys.readouterr().out
+            # the per-target summary lines name both replicas
+            assert base1 in printed and base2 in printed
+            rep = lg_report.load_report(out)
+            assert rep["targets"] == [base1, base2]
+            assert set(rep["per_target"]) == {base1, base2}
+        finally:
+            h2.shutdown()
+            s2.batcher.close()
+            h2.server_close()
+
+
 class TestAcceptance:
     """ISSUE acceptance: self-consistency gate passes on a warmed
     server; an injected slowdown fails the p99 gate with exit != 0."""

@@ -538,6 +538,10 @@ def _scripted_server(script):
     state = _ScriptedHandler(script)
 
     class H(BaseHTTPRequestHandler):
+        # keep-alive, like the real serve handler - lets the client
+        # tests below exercise connection-reuse accounting
+        protocol_version = "HTTP/1.1"
+
         def log_message(self, fmt, *args):
             pass
 
@@ -660,6 +664,60 @@ class TestClient:
             # the remaining budget rode the body as deadline_ms
             sent = state.seen[0]["body"]
             assert 0 < sent["deadline_ms"] <= 3000
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_keepalive_reuses_connection_across_requests(self):
+        httpd, state, base = _scripted_server([])  # default 200s
+        try:
+            c = self._client(base, retries=0)
+            assert c.solve({"N": 8}).ok
+            assert c.solve({"N": 8}).ok
+            assert c.solve({"N": 8}).ok
+            # one socket carried all three requests
+            assert c.connections_opened == 1
+            assert c.requests_on_reused_connection == 2
+            assert c.connection_resets == 0
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_connection_close_header_retires_socket_orderly(self):
+        httpd, state, base = _scripted_server([
+            (200, {"status": "ok"}, {"Connection": "close"}),
+            (200, {"status": "ok"}, {}),
+        ])
+        try:
+            c = self._client(base, retries=0)
+            assert c.solve({"N": 8}).ok
+            assert c.solve({"N": 8}).ok
+            # the announced close forced a reconnect, but it is NOT a
+            # reset - that counter only tracks surprise failures
+            assert c.connections_opened == 2
+            assert c.connection_resets == 0
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_stale_kept_alive_socket_costs_one_status0_retry(self):
+        httpd, state, base = _scripted_server([
+            (200, {"status": "ok"}, {}),
+            (-1, None, None),  # server kills the kept-alive socket
+        ])
+        try:
+            c = self._client(base, retries=2)
+            assert c.solve({"N": 8}).ok
+            out = c.solve({"N": 8})
+            # the dead socket cost one retriable status-0 attempt and
+            # one counted reset; the retry reconnected and succeeded
+            assert out.ok and out.attempts == 2
+            assert out.retries[0]["status"] == 0
+            assert c.connection_resets == 1
+            assert c.connections_opened == 2
+            # request 3 rides the fresh socket again
+            assert c.solve({"N": 8}).ok
+            assert c.requests_on_reused_connection >= 1
         finally:
             httpd.shutdown()
             httpd.server_close()

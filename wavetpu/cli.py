@@ -212,18 +212,10 @@ _VALUELESS = (
 )
 
 
-def resolve_kernel(flag_value: str, platform: str) -> str:
-    """Map --kernel {auto,roll,pallas} to the concrete kernel for
-    `platform` (jax.default_backend()).  auto = pallas only where Mosaic
-    compiles it natively; everywhere else the roll stencil is the fast
-    path and interpret-mode pallas is opt-in."""
-    if flag_value not in ("auto", "roll", "pallas"):
-        raise ValueError(
-            f"--kernel must be auto|roll|pallas, got {flag_value}"
-        )
-    if flag_value == "auto":
-        return "pallas" if platform == "tpu" else "roll"
-    return flag_value
+# resolve_kernel moved to `wavetpu.progkey` (the fleet router resolves
+# kernel=auto from polled replica backends without jax); re-exported
+# here for the existing callers.
+from wavetpu.progkey import resolve_kernel  # noqa: E402,F401
 
 
 def _split_flags(argv: Sequence[str]) -> Tuple[List[str], dict]:
@@ -265,6 +257,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from wavetpu.obs import perf as obs_perf
 
         return obs_perf.profile_main(argv[1:])
+    if argv and argv[0] == "router":
+        # Fleet front tier: ProgramKey-affinity proxy over N serve
+        # replicas (stdlib-only; never touches jax - routers run on
+        # hosts with no accelerator stack).
+        from wavetpu.fleet import router as fleet_router
+
+        return fleet_router.main(argv[1:])
+    if argv and argv[0] == "fleet":
+        # Fleet operations; currently `fleet roll`, the warm-handoff
+        # zero-cold-compile rolling-deploy driver (stdlib-only).
+        if len(argv) > 1 and argv[1] == "roll":
+            from wavetpu.fleet import roll as fleet_roll
+
+            return fleet_roll.main(argv[2:])
+        print("error: fleet wants a subcommand: roll", file=sys.stderr)
+        print("usage: wavetpu fleet roll ...", file=sys.stderr)
+        return 2
     if argv and argv[0] == "warmup":
         # Manifest-driven replica warmup: pre-populate a persistent
         # program cache from a ledger-report warmup manifest.

@@ -39,12 +39,13 @@ loadgen/runner.py, which adopts this client behind `--retries`).
 from __future__ import annotations
 
 import dataclasses
+import http.client
 import json
 import random
+import threading
 import time
-import urllib.error
-import urllib.request
-from typing import Callable, Dict, List, Optional
+import urllib.parse
+from typing import Callable, Dict, List, Optional, Tuple
 
 # Outcomes worth a retry: transport failure (status 0), backpressure
 # (429), engine failure (500 - the batch died, a retry lands in a fresh
@@ -90,15 +91,27 @@ def parse_retry_after(headers: Dict[str, str]) -> Optional[float]:
 
 
 class WavetpuClient:
-    """Thread-safe-enough stdlib client (urllib per call, a lock-free
-    counter for minted ids is the only shared state - worst case two
-    threads mint the same id, which only merges two trace views).
+    """Thread-safe stdlib client with KEEP-ALIVE: one persistent
+    `http.client.HTTPConnection` per calling thread (threading.local),
+    reused across requests - the serve handler speaks HTTP/1.1, so the
+    per-request TCP handshake the old urllib transport paid (and the
+    fleet router tier would have amplified 2x) is gone.  Any transport
+    error closes and resets that thread's connection, so the NEXT
+    attempt reconnects fresh - a stale kept-alive socket (server
+    drained, restarted, or chaos-dropped between requests) costs one
+    retriable status-0 attempt, never a wedged client.  A response
+    carrying `Connection: close` (drain 503, 413) retires the socket
+    in an orderly way (not counted as a reset).
 
     `retries` is the RETRY budget (total attempts = retries + 1);
     `deadline_s` the default per-request budget (None = unbounded);
     `backoff_base_s`/`backoff_max_s` shape the jittered exponential
     curve `min(max, base * 2^attempt) * uniform(0.5, 1.0)`.  `rng` and
-    `sleep` are injectable for deterministic tests."""
+    `sleep` are injectable for deterministic tests.
+
+    Connection accounting (for tests and the loadgen report):
+    `connections_opened` / `requests_on_reused_connection` /
+    `connection_resets` under one stats lock."""
 
     def __init__(
         self,
@@ -118,6 +131,14 @@ class WavetpuClient:
                 f"deadline_s must be > 0, got {deadline_s}"
             )
         self.base_url = base_url.rstrip("/")
+        parts = urllib.parse.urlsplit(self.base_url)
+        if parts.scheme != "http" or not parts.hostname:
+            raise ValueError(
+                f"base_url must be http://host[:port], got {base_url!r}"
+            )
+        self._host = parts.hostname
+        self._port = parts.port or 80
+        self._path_prefix = parts.path.rstrip("/")
         self.retries = retries
         self.timeout = timeout
         self.deadline_s = deadline_s
@@ -127,31 +148,94 @@ class WavetpuClient:
         self._sleep = sleep
         self._n = 0
         self._tag = f"{int(time.time() * 1e3) & 0xFFFFFFFF:x}"
+        self._local = threading.local()
+        self._stats_lock = threading.Lock()
+        self.connections_opened = 0
+        self.requests_on_reused_connection = 0
+        self.connection_resets = 0
 
     def _mint(self) -> str:
         self._n += 1
         return f"cl-{self._tag}-{self._n}"
 
-    # ---- transport ----
+    # ---- transport (keep-alive) ----
+
+    def _conn(self, timeout: float) -> Tuple[http.client.HTTPConnection,
+                                             bool]:
+        """This thread's persistent connection (created on first use),
+        with the socket timeout refreshed for this request.  Returns
+        (conn, reused) - reused=True when the socket is already up."""
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = http.client.HTTPConnection(
+                self._host, self._port, timeout=timeout
+            )
+            self._local.conn = conn
+            with self._stats_lock:
+                self.connections_opened += 1
+        reused = conn.sock is not None
+        conn.timeout = timeout
+        if conn.sock is not None:
+            conn.sock.settimeout(timeout)
+        return conn, reused
+
+    def _reset_conn(self, orderly: bool = False) -> None:
+        """Close and forget this thread's connection (next request
+        reconnects).  `orderly` = the server announced `Connection:
+        close`; anything else counts as a reset."""
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            return
+        try:
+            conn.close()
+        except Exception:
+            pass
+        self._local.conn = None
+        if not orderly:
+            with self._stats_lock:
+                self.connection_resets += 1
+
+    def close(self) -> None:
+        """Retire the CALLING thread's persistent connection (other
+        threads' sockets close when their conns are garbage-collected)."""
+        self._reset_conn(orderly=True)
+
+    def _request(self, method: str, path: str, data: Optional[bytes],
+                 headers: Dict[str, str], timeout: float
+                 ) -> Tuple[int, bytes, Dict[str, str]]:
+        """One HTTP exchange on the thread's kept-alive connection.
+        Raises OSError/http.client errors on transport failure (after
+        resetting the connection so the next attempt reconnects)."""
+        conn, reused = self._conn(timeout)
+        try:
+            conn.request(method, self._path_prefix + path, body=data,
+                         headers=headers)
+            resp = conn.getresponse()
+            raw = resp.read()
+        except Exception:
+            self._reset_conn()
+            raise
+        if reused:
+            with self._stats_lock:
+                self.requests_on_reused_connection += 1
+        if resp.will_close:
+            self._reset_conn(orderly=True)
+        return resp.status, raw, dict(resp.headers)
 
     def _attempt(self, body: dict, rid: str, timeout: float):
         """One POST /solve: (status, payload, headers, error)."""
-        req = urllib.request.Request(
-            self.base_url + "/solve", data=json.dumps(body).encode(),
-            headers={
-                "Content-Type": "application/json",
-                "X-Request-Id": rid,
-            },
-        )
         try:
-            with urllib.request.urlopen(req, timeout=timeout) as r:
-                raw = r.read()
-                status, headers = r.status, dict(r.headers)
-        except urllib.error.HTTPError as e:
-            raw = e.read()
-            status, headers = e.code, dict(e.headers)
-        except (OSError, urllib.error.URLError) as e:
-            return 0, None, {}, str(e)
+            status, raw, headers = self._request(
+                "POST", "/solve", json.dumps(body).encode(),
+                {
+                    "Content-Type": "application/json",
+                    "X-Request-Id": rid,
+                },
+                timeout,
+            )
+        except (OSError, http.client.HTTPException) as e:
+            return 0, None, {}, f"{type(e).__name__}: {e}" if str(e) \
+                else type(e).__name__
         try:
             payload = json.loads(raw or b"{}")
         except (ValueError, TypeError):
@@ -162,10 +246,9 @@ class WavetpuClient:
         return status, payload, headers, error
 
     def healthz(self, timeout: float = 10.0) -> dict:
-        with urllib.request.urlopen(
-            self.base_url + "/healthz", timeout=timeout
-        ) as r:
-            return json.loads(r.read())
+        status, raw, _headers = self._request("GET", "/healthz", None,
+                                              {}, timeout)
+        return json.loads(raw)
 
     # ---- the retry loop ----
 

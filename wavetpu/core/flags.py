@@ -19,14 +19,20 @@ def split_flags(
     known: Sequence[str],
     valueless: Sequence[str] = (),
     allow_positionals: bool = True,
-) -> Tuple[List[str], Dict[str, str]]:
+    repeatable: Sequence[str] = (),
+) -> Tuple[List[str], Dict[str, object]]:
     """Separate positionals from --flag[=value] options.
 
     Raises ValueError for unknown flags, a flag missing its value, or
     (with `allow_positionals=False`) any positional - so typos surface
-    as the caller's usage error instead of being silently ignored."""
+    as the caller's usage error instead of being silently ignored.
+
+    A repeated flag is last-wins (the shell-override idiom) UNLESS it
+    is listed in `repeatable`, in which case its value is a LIST of
+    every occurrence in argv order (the multi-replica `--target` /
+    `--backend` dialect of loadgen and the fleet router)."""
     pos: List[str] = []
-    flags: Dict[str, str] = {}
+    flags: Dict[str, object] = {}
     it = iter(argv)
     for a in it:
         if a.startswith("--"):
@@ -42,7 +48,10 @@ def split_flags(
                         raise ValueError(f"flag --{k} needs a value")
             if k not in known:
                 raise ValueError(f"unknown flag --{k}")
-            flags[k] = v
+            if k in repeatable:
+                flags.setdefault(k, []).append(v)
+            else:
+                flags[k] = v
         else:
             if not allow_positionals:
                 raise ValueError(f"unexpected positional {a!r}")

@@ -1,0 +1,40 @@
+"""Fleet tier: N `wavetpu serve` replicas behind one affinity router.
+
+One `wavetpu serve` process is one scheduler worker in front of one
+accelerator; a fleet is N of them behind `wavetpu router` - a stdlib
+ThreadingHTTPServer front (same discipline as serve/api.py) that:
+
+ * derives each /solve body's program identity with the SAME shared
+   key-derivation the engine uses (`wavetpu.progkey` - the module
+   factored out of serve/engine.py so router and engine cannot drift),
+ * routes warm keys to the replica that already holds the compiled
+   program (warm-key tables learned from replica `/metrics`
+   `program_cache.warm_keys` polls plus every proxied response's
+   `Server-Timing: warm;desc=` label),
+ * falls back to least-loaded power-of-two-choices for cold keys,
+ * health-gates membership on `/healthz` polls (`ready: false` or
+   repeated transport failures eject; recovery re-admits),
+ * absorbs a draining replica's 503s by retrying on a live member, and
+ * aggregates member Prometheus counters (including frozen snapshots
+   of departed members) so `wavetpu loadgen` pointed at the router
+   sees fleet-wide monotonic deltas across a rolling deploy.
+
+`wavetpu fleet roll` is the zero-cold-compile deploy driver: start the
+successor with `--warmup-manifest` built from the fleet's shared
+compile ledger, wait for readiness, join it to the router, then drain
+and remove the predecessor - clients retrying through `WavetpuClient`
+(or the router's own retry) never see the cutover.
+
+Modules (all stdlib-only, never import jax - the router runs on hosts
+with no accelerator stack):
+
+  membership.py  health-gated member table + poll loop
+  affinity.py    warm-key table + hit/rerouted/cold routing decisions
+  router.py      the HTTP proxy tier (`wavetpu router`)
+  roll.py        the rolling-deploy driver (`wavetpu fleet roll`)
+
+Contract and runbook: docs/fleet.md.
+"""
+
+from wavetpu.fleet.affinity import AffinityTable  # noqa: F401
+from wavetpu.fleet.membership import Member, MembershipTable  # noqa: F401

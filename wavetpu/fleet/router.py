@@ -1,0 +1,675 @@
+"""`wavetpu router` - the ProgramKey-affinity fleet front tier.
+
+A stdlib ThreadingHTTPServer (the serve/api.py discipline: handler
+threads block on upstream I/O, one shared state object on the server)
+that proxies /solve across N `wavetpu serve` replicas:
+
+  POST /solve       derive the body's program identity with the SHARED
+                    key module (`wavetpu.progkey` - the same derivation
+                    the engine caches under, so router and engine
+                    cannot drift), land it on a replica that already
+                    holds the compiled program (fleet/affinity.py),
+                    else least-loaded power-of-two-choices.  A
+                    transport failure or a 503 (draining / breaker /
+                    crashed-worker replica) is RETRIED on a different
+                    live member before the client ever sees it; only
+                    when every member refused does the router answer
+                    503 + Retry-After + retriable (which WavetpuClient
+                    absorbs with backoff).  The response carries
+                    `X-Wavetpu-Member` naming the replica that served.
+  GET /healthz      router liveness + readiness (`ready` = at least
+                    one routable member) + per-member state summary.
+  GET /metrics      JSON (default): router counters, affinity stats
+                    (hit/rerouted/cold + hit_rate), per-member summary
+                    and proxied counts.  `Accept: text/plain`: the
+                    FLEET-WIDE Prometheus cut - sample-wise sum over
+                    every member ever seen (departed members contribute
+                    frozen snapshots; mid-flight joiners contribute
+                    growth since join, their warmup history baselined
+                    away - so `wavetpu loadgen` pointed at the router
+                    sees monotonic, roll-clean deltas across a rolling
+                    deploy) plus the router's own wavetpu_router_*
+                    samples.
+  POST /admin/join  {"url": U} - add a member (admitted to rotation
+                    when its /healthz says ready).
+  POST /admin/leave {"url": U} - drain U (POST its /admin/drain),
+                    keep polling its counters while it flushes, then
+                    retire it with counters frozen.  The roll driver's
+                    cutover primitive.
+
+Stdlib-only; NEVER imports jax (routers run on hosts with no
+accelerator stack).  Contract and runbook: docs/fleet.md.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import random
+import sys
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Sequence, Tuple
+
+from wavetpu import progkey
+from wavetpu.core.flags import split_flags
+from wavetpu.fleet.affinity import (
+    AffinityTable,
+    warm_label_from_server_timing,
+)
+from wavetpu.fleet.membership import LEFT, MembershipTable
+
+_USAGE = (
+    "usage: wavetpu router --member URL [--member URL2 ...] "
+    "[--host H] [--port P] [--poll-interval-s S] [--fail-threshold K] "
+    "[--proxy-timeout-s S] [--max-body-bytes B]"
+)
+
+# Response headers worth forwarding verbatim from replica to client
+# (the rest are hop-by-hop or recomputed by the router's send path).
+_FORWARD_RESPONSE_HEADERS = (
+    "X-Request-Id", "Server-Timing", "Retry-After",
+)
+# Request headers forwarded replica-ward.
+_FORWARD_REQUEST_HEADERS = (
+    "Content-Type", "X-Request-Id", "X-Deadline-Ms",
+)
+
+
+class _ProxyConns:
+    """Thread-local kept-alive upstream connections, one per (handler
+    thread, member) - the router pays the TCP handshake once per
+    member per thread, not once per proxied request (the replicas
+    speak HTTP/1.1)."""
+
+    def __init__(self):
+        self._local = threading.local()
+
+    def _pool(self) -> Dict[str, http.client.HTTPConnection]:
+        pool = getattr(self._local, "pool", None)
+        if pool is None:
+            pool = {}
+            self._local.pool = pool
+        return pool
+
+    def request(self, base_url: str, method: str, path: str,
+                body: Optional[bytes], headers: Dict[str, str],
+                timeout: float) -> Tuple[int, bytes, Dict[str, str]]:
+        """One exchange on the kept-alive connection to `base_url`;
+        raises OSError/http.client errors on transport failure (after
+        dropping the dead connection so the next try reconnects)."""
+        pool = self._pool()
+        conn = pool.get(base_url)
+        if conn is None:
+            parts = urllib.parse.urlsplit(base_url)
+            conn = http.client.HTTPConnection(
+                parts.hostname, parts.port or 80, timeout=timeout
+            )
+            pool[base_url] = conn
+        conn.timeout = timeout
+        if conn.sock is not None:
+            conn.sock.settimeout(timeout)
+        try:
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            raw = resp.read()
+        except Exception:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            pool.pop(base_url, None)
+            raise
+        if resp.will_close:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            pool.pop(base_url, None)
+        return resp.status, raw, dict(resp.headers)
+
+    def drop(self, base_url: str) -> None:
+        conn = self._pool().pop(base_url, None)
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+
+class RouterState:
+    """Shared router state: membership + affinity + counters."""
+
+    def __init__(self, table: MembershipTable, affinity: AffinityTable,
+                 proxy_timeout: float = 120.0,
+                 max_body_bytes: Optional[int] = None):
+        self.table = table
+        self.affinity = affinity
+        self.proxy_timeout = proxy_timeout
+        self.max_body_bytes = max_body_bytes
+        self.conns = _ProxyConns()
+        self.started = time.time()
+        self._lock = threading.Lock()
+        self.requests_total = 0
+        self.retried_requests = 0      # requests needing >1 member
+        self.retries_total = 0         # extra member attempts
+        self.exhausted_total = 0       # every member refused -> 503
+        self.unparseable_total = 0     # body gave no identity (routed
+        #                                anyway; the replica 400s it)
+        self.proxied_per_member: Dict[str, int] = {}
+        self._poll_stop = threading.Event()
+        self._poller: Optional[threading.Thread] = None
+
+    # ---- load signal for power-of-two-choices ----
+
+    def load_of(self, url: str) -> float:
+        m = self.table.get(url)
+        if m is None:
+            return 0.0
+        # Router-side inflight is fresh per request; queue depth is as
+        # fresh as the last poll - together they bias p2c away from a
+        # member that is busy RIGHT NOW or was backed up recently.
+        return float(m.inflight + m.queue_depth)
+
+    def note_proxied(self, url: str, retried: bool,
+                     extra_attempts: int) -> None:
+        with self._lock:
+            self.proxied_per_member[url] = (
+                self.proxied_per_member.get(url, 0) + 1
+            )
+            if retried:
+                self.retried_requests += 1
+            self.retries_total += extra_attempts
+
+    # ---- background health poll ----
+
+    def start_poller(self, interval_s: float) -> None:
+        def _loop():
+            while not self._poll_stop.wait(interval_s):
+                try:
+                    self.table.poll_once()
+                except Exception:
+                    pass  # a poll crash must never kill the loop
+
+        self._poller = threading.Thread(
+            target=_loop, name="wavetpu-router-poll", daemon=True
+        )
+        self._poller.start()
+
+    def stop_poller(self) -> None:
+        self._poll_stop.set()
+        if self._poller is not None:
+            self._poller.join(timeout=5.0)
+
+    # ---- leave orchestration (the roll cutover primitive) ----
+
+    def leave_member(self, url: str, drain: bool = True,
+                     drain_wait_s: float = 30.0,
+                     sync: bool = False) -> bool:
+        """Mark `url` LEAVING (out of rotation now), drain it, keep
+        snapshotting its counters while it flushes, then retire it
+        (counters frozen).  Runs in the background unless sync=True
+        (tests); returns whether the member existed."""
+        m = self.table.leave(url)
+        if m is None:
+            return False
+
+        def _drain_and_retire():
+            if drain:
+                try:
+                    # A short-lived one-shot connection: the member is
+                    # about to close every socket anyway.
+                    self.conns.drop(m.base_url)
+                    parts = urllib.parse.urlsplit(m.base_url)
+                    conn = http.client.HTTPConnection(
+                        parts.hostname, parts.port or 80, timeout=10.0
+                    )
+                    try:
+                        conn.request("POST", "/admin/drain")
+                        conn.getresponse().read()
+                    finally:
+                        conn.close()
+                except Exception:
+                    pass  # already down = already drained
+            deadline = time.monotonic() + drain_wait_s
+            while time.monotonic() < deadline:
+                # Liveness probe FIRST: a drained replica stops
+                # accepting the moment its serve loop exits, and
+                # burning the metrics-fetch timeouts against a dead
+                # socket would stall the cutover for nothing.
+                try:
+                    self.table._fetch(  # noqa: SLF001
+                        m.base_url, "/healthz", 2.0, None
+                    )
+                except Exception:
+                    break  # process gone: last snapshot is final
+                try:
+                    self.table.refresh_metrics(m)
+                except Exception:
+                    pass
+                time.sleep(0.2)
+            self.table.retire(m.base_url)
+
+        if sync:
+            _drain_and_retire()
+        else:
+            threading.Thread(
+                target=_drain_and_retire,
+                name="wavetpu-router-leave", daemon=True,
+            ).start()
+        return True
+
+    # ---- fleet platform (for kernel:auto identity resolution) ----
+
+    def platform(self) -> str:
+        for m in self.table.routable_members():
+            if m.backend:
+                return m.backend
+        for m in self.table.members():
+            if m.backend:
+                return m.backend
+        return "cpu"
+
+    # ---- metrics views ----
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            per_member = dict(self.proxied_per_member)
+            snap = {
+                "router": True,
+                "uptime_seconds": round(time.time() - self.started, 3),
+                "requests_total": self.requests_total,
+                "retried_requests": self.retried_requests,
+                "retries_total": self.retries_total,
+                "exhausted_total": self.exhausted_total,
+                "unparseable_total": self.unparseable_total,
+            }
+        snap["affinity"] = self.affinity.stats()
+        members = self.table.summary()
+        for row in members:
+            row["proxied_total"] = per_member.get(row["url"], 0)
+        snap["members"] = members
+        return snap
+
+    def render_prometheus(self) -> str:
+        """Fleet-wide text exposition: summed member samples (frozen
+        snapshots included - monotonic across a roll) + router-own
+        wavetpu_router_* samples."""
+        agg = self.table.aggregate_prom(refresh=True)
+        snap = self.snapshot()
+        aff = snap["affinity"]
+        own: Dict[str, float] = {
+            "wavetpu_router_requests_total": snap["requests_total"],
+            "wavetpu_router_retried_requests_total":
+                snap["retried_requests"],
+            "wavetpu_router_retries_total": snap["retries_total"],
+            "wavetpu_router_exhausted_total": snap["exhausted_total"],
+            'wavetpu_router_affinity_decisions_total{decision="hit"}':
+                aff["hits"],
+            'wavetpu_router_affinity_decisions_total{decision="rerouted"}':
+                aff["rerouted"],
+            'wavetpu_router_affinity_decisions_total{decision="cold"}':
+                aff["cold"],
+            "wavetpu_router_affinity_known_keys": aff["known_keys"],
+        }
+        for row in snap["members"]:
+            url = row["url"]
+            own[
+                'wavetpu_router_member_proxied_total'
+                f'{{member="{url}"}}'
+            ] = row["proxied_total"]
+        by_state: Dict[str, int] = {}
+        for row in snap["members"]:
+            by_state[row["state"]] = by_state.get(row["state"], 0) + 1
+        for state, n in sorted(by_state.items()):
+            own[f'wavetpu_router_members{{state="{state}"}}'] = n
+        lines = [f"{k} {float(v)}" for k, v in sorted(agg.items())]
+        lines += [f"{k} {float(v)}" for k, v in sorted(own.items())]
+        return "\n".join(lines) + "\n"
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    # Same HTTP/1.1 + single-send-path discipline as serve/api.py: the
+    # keep-alive WavetpuClient holds one socket to the router across a
+    # whole replay; error paths that skip reading the request body
+    # answer with Connection: close.
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # noqa: D102 (quiet, like serve)
+        pass
+
+    @property
+    def rstate(self) -> RouterState:
+        return self.server.wavetpu_router
+
+    def _send(self, code: int, payload: dict,
+              headers: Optional[dict] = None) -> None:
+        self._send_bytes(code, json.dumps(payload).encode(),
+                         "application/json", headers)
+
+    def _send_bytes(self, code: int, body: bytes, content_type: str,
+                    headers: Optional[dict] = None) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    # ---- GET ----
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib contract)
+        st = self.rstate
+        if self.path == "/healthz":
+            members = st.table.summary()
+            up = sum(1 for m in members if m["state"] == "up")
+            self._send(200, {
+                "status": "ok",
+                "router": True,
+                # Preflight-compatible readiness: route here iff at
+                # least one member can take traffic.
+                "ready": up > 0,
+                "draining": False,
+                "uptime_seconds": round(time.time() - st.started, 3),
+                "members_up": up,
+                "members": members,
+            })
+        elif self.path == "/metrics":
+            accept = self.headers.get("Accept", "") or ""
+            wants_text = (
+                "application/json" not in accept
+                and ("text/plain" in accept or "openmetrics" in accept)
+            )
+            if wants_text:
+                self._send_bytes(
+                    200, st.render_prometheus().encode(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+            else:
+                self._send(200, st.snapshot())
+        else:
+            self._send(404, {"status": "error", "error": "not found"})
+
+    # ---- POST ----
+
+    def _read_body(self) -> Optional[bytes]:
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            return None
+        limit = self.rstate.max_body_bytes
+        if limit is not None and length > limit:
+            return None
+        return self.rfile.read(length) if length > 0 else b""
+
+    def do_POST(self) -> None:  # noqa: N802
+        st = self.rstate
+        if self.path in ("/admin/join", "/admin/leave"):
+            raw = self._read_body()
+            try:
+                body = json.loads(raw or b"{}")
+                url = body["url"]
+            except (ValueError, KeyError, TypeError):
+                self._send(400, {
+                    "status": "error",
+                    "error": 'admin body must be {"url": "http://..."}',
+                }, {"Connection": "close"})
+                return
+            if self.path == "/admin/join":
+                # baseline=True: a mid-flight joiner's pre-join
+                # counters (manifest warmup) must not show up as fleet
+                # delta growth.
+                m = st.table.add(url, baseline=True)
+                # Admit without waiting for the next poll tick - the
+                # roll driver polls router /healthz for the flip.
+                st.table.poll_member(m)
+                self._send(200, {"status": "ok", "member": m.summary()})
+            else:
+                found = st.leave_member(
+                    url,
+                    drain=bool(body.get("drain", True)),
+                    drain_wait_s=float(body.get("drain_wait_s", 30.0)),
+                    sync=bool(body.get("sync", False)),
+                )
+                if not found:
+                    self._send(404, {
+                        "status": "error",
+                        "error": f"unknown member {url}",
+                    })
+                else:
+                    self._send(200, {"status": "ok", "leaving": url})
+            return
+        if self.path != "/solve":
+            self._send(404, {"status": "error", "error": "not found"},
+                       {"Connection": "close"})
+            return
+        raw = self._read_body()
+        if raw is None:
+            self._send(413, {
+                "status": "error",
+                "error": "request body too large for this router",
+            }, {"Connection": "close"})
+            return
+        self._proxy_solve(raw)
+
+    # ---- the proxy data path ----
+
+    def _affinity_key(self, raw: bytes) -> Optional[str]:
+        """The request's routing identity, or None (unkeyed: malformed
+        bodies are still FORWARDED - the replica owns the 400 contract;
+        the router must stay transparent to error-shape tests)."""
+        st = self.rstate
+        try:
+            body = json.loads(raw)
+            return progkey.identity_from_body(
+                body, platform=st.platform
+            ).affinity_key()
+        except (ValueError, TypeError, KeyError):
+            with st._lock:  # noqa: SLF001
+                st.unparseable_total += 1
+            return None
+
+    def _proxy_solve(self, raw: bytes) -> None:
+        st = self.rstate
+        with st._lock:  # noqa: SLF001
+            st.requests_total += 1
+        ak = self._affinity_key(raw)
+        fwd_headers = {
+            h: self.headers[h]
+            for h in _FORWARD_REQUEST_HEADERS if self.headers.get(h)
+        }
+        fwd_headers.setdefault("Content-Type", "application/json")
+        tried = []
+        last: Optional[Tuple[int, bytes, Dict[str, str]]] = None
+        while True:
+            candidates = [
+                u for u in st.table.routable_urls() if u not in tried
+            ]
+            if not candidates:
+                break
+            if tried:
+                url = self._retry_pick(candidates)
+            else:
+                url = st.affinity.choose(ak, candidates, st.load_of)
+            member = st.table.get(url)
+            if member is not None:
+                with st.table._lock:  # noqa: SLF001
+                    member.inflight += 1
+            try:
+                status, body, headers = st.conns.request(
+                    url, "POST", "/solve", raw, fwd_headers,
+                    st.proxy_timeout,
+                )
+                last = (status, body, headers)
+            except (OSError, http.client.HTTPException):
+                status, last = 0, None
+            finally:
+                if member is not None:
+                    with st.table._lock:  # noqa: SLF001
+                        member.inflight = max(0, member.inflight - 1)
+            tried.append(url)
+            if status == 200 and ak is not None:
+                st.affinity.observe_response(
+                    url, ak,
+                    warm_label_from_server_timing(
+                        (last[2] if last else {}).get("Server-Timing")
+                    ),
+                )
+            # Transport failures and 503s (draining / breaker /
+            # crashed worker) are MEMBER problems, not request
+            # problems: try a different member before surfacing
+            # anything.  Every other status is the request's answer.
+            if status not in (0, 503):
+                break
+        retried = len(tried) > 1
+        if last is not None and last[0] not in (0, 503):
+            status, body, headers = last
+            out = {
+                h: headers[h]
+                for h in _FORWARD_RESPONSE_HEADERS if headers.get(h)
+            }
+            out["X-Wavetpu-Member"] = tried[-1]
+            st.note_proxied(tried[-1], retried, len(tried) - 1)
+            self._send_bytes(
+                status, body,
+                headers.get("Content-Type", "application/json"), out,
+            )
+            return
+        # Exhausted: every member refused (or none exist).  Answer in
+        # the replica's own retriable-503 shape so WavetpuClient backs
+        # off and retries through the cutover exactly as it would
+        # against a single draining replica.
+        with st._lock:  # noqa: SLF001
+            st.exhausted_total += 1
+            if retried:
+                st.retried_requests += 1
+            st.retries_total += max(0, len(tried) - 1)
+        if last is not None and last[0] == 503:
+            out = {
+                h: last[2][h]
+                for h in _FORWARD_RESPONSE_HEADERS if last[2].get(h)
+            }
+            out.setdefault("Retry-After", "2")
+            out["X-Wavetpu-Member"] = tried[-1]
+            self._send_bytes(
+                503, last[1],
+                last[2].get("Content-Type", "application/json"), out,
+            )
+            return
+        self._send(503, {
+            "status": "error",
+            "error": (
+                "no live fleet member could serve the request"
+                if tried else "fleet has no routable members"
+            ),
+            "retriable": True,
+        }, {"Retry-After": "2"})
+
+    def _retry_pick(self, candidates) -> str:
+        """Retry attempts skip the affinity counters (one request, one
+        counted decision) and just take the least-loaded pair pick."""
+        st = self.rstate
+        if len(candidates) == 1:
+            return candidates[0]
+        pair = random.sample(list(candidates), 2)
+        return min(pair, key=st.load_of)
+
+
+def build_router(
+    member_urls: Sequence[str],
+    host: str = "127.0.0.1",
+    port: int = 0,
+    poll_interval_s: float = 2.0,
+    fail_threshold: int = 3,
+    proxy_timeout: float = 120.0,
+    max_body_bytes: Optional[int] = None,
+    fetch=None,
+    rng: Optional[random.Random] = None,
+    start_poller: bool = True,
+) -> Tuple[ThreadingHTTPServer, RouterState]:
+    """Assemble membership + affinity + HTTP front (port 0 =
+    ephemeral).  Does ONE synchronous poll before returning so the
+    rotation is populated the moment the caller starts serving; the
+    periodic poller (start_poller) keeps it fresh.  Returned httpd is
+    not yet serving - call serve_forever() (main does) or drive it
+    from a thread (tests do)."""
+    affinity = AffinityTable(rng=rng)
+    table = MembershipTable(
+        member_urls, fail_threshold=fail_threshold, fetch=fetch,
+        affinity=affinity,
+    )
+    state = RouterState(
+        table, affinity, proxy_timeout=proxy_timeout,
+        max_body_bytes=max_body_bytes,
+    )
+    table.poll_once()
+    httpd = ThreadingHTTPServer((host, port), _RouterHandler)
+    httpd.wavetpu_router = state
+    if start_poller:
+        state.start_poller(poll_interval_s)
+    return httpd, state
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    try:
+        pos, flags = split_flags(
+            argv,
+            known=("member", "host", "port", "poll-interval-s",
+                   "fail-threshold", "proxy-timeout-s",
+                   "max-body-bytes"),
+            allow_positionals=False,
+            repeatable=("member",),
+        )
+        members = list(flags.get("member") or [])
+        if not members:
+            raise ValueError("router needs at least one --member URL")
+        host = flags.get("host", "127.0.0.1")
+        port = int(flags.get("port", "8070"))
+        poll_interval_s = float(flags.get("poll-interval-s", "2"))
+        fail_threshold = int(flags.get("fail-threshold", "3"))
+        proxy_timeout = float(flags.get("proxy-timeout-s", "120"))
+        max_body_bytes = (
+            int(flags["max-body-bytes"])
+            if "max-body-bytes" in flags else None
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        print(_USAGE, file=sys.stderr)
+        return 2
+    httpd, state = build_router(
+        members, host=host, port=port,
+        poll_interval_s=poll_interval_s, fail_threshold=fail_threshold,
+        proxy_timeout=proxy_timeout, max_body_bytes=max_body_bytes,
+    )
+    bound = httpd.server_address
+    up = len(state.table.routable_urls())
+    print(
+        f"wavetpu router on http://{bound[0]}:{bound[1]} "
+        f"({up}/{len(members)} members up, poll every "
+        f"{poll_interval_s:g}s, fail threshold {fail_threshold})"
+    )
+    for m in state.table.summary():
+        print(f"  member {m['url']}: {m['state']}"
+              + (f" [{m['backend']}]" if m["backend"] else ""))
+    import signal
+
+    def _shutdown(signum, frame):
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _shutdown)
+    signal.signal(signal.SIGINT, _shutdown)
+    try:
+        httpd.serve_forever()
+    finally:
+        state.stop_poller()
+        httpd.server_close()
+    print("wavetpu router: shut down")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

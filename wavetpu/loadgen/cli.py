@@ -3,12 +3,18 @@
     wavetpu loadgen generate --out TRACE.jsonl [--mix poisson]
         [--duration S] [--qps Q] [--seed N] [--n N] [--timesteps T]
         [--pallas] [--distinct D]
-    wavetpu loadgen replay TRACE.jsonl --target URL [--mode open|closed]
+    wavetpu loadgen replay TRACE.jsonl --target URL [--target URL2 ...]
+        [--mode open|closed]
         [--concurrency C] [--speed X] [--warmup W] [--timeout S]
         [--retries N] [--duration SECONDS]
         [--out REPORT.json] [--no-preflight]
         [--baseline OLD.json] [SLO flags]
     wavetpu loadgen gate REPORT.json --baseline OLD.json [SLO flags]
+
+Repeating `--target` fans the replay out round-robin across N replica
+URLs (a router-less fleet drill); the report carries a `per_target`
+request/error breakdown so failures attribute to a replica, and
+server-side metric deltas are summed across all targets.
 
 `--retries N` sends every request through the retrying WavetpuClient
 (jittered backoff honoring Retry-After, request-id reuse across
@@ -129,11 +135,13 @@ def _replay(argv: Sequence[str]) -> int:
                    "retries", "duration")
             + tuple(_SLO_FLAGS),
             valueless=("no-preflight",),
+            repeatable=("target",),
         )
         if len(pos) != 1:
             raise ValueError("replay wants exactly one TRACE.jsonl")
         if "target" not in flags:
             raise ValueError("replay needs --target URL")
+        targets = list(flags["target"])
         mode = flags.get("mode", "open")
         concurrency = int(flags.get("concurrency", "4"))
         speed = float(flags.get("speed", "1"))
@@ -151,7 +159,7 @@ def _replay(argv: Sequence[str]) -> int:
         return _usage_error(f"cannot read trace: {e}")
     try:
         result = runner.replay(
-            flags["target"], records, mode=mode,
+            targets, records, mode=mode,
             concurrency=concurrency, speed=speed, warmup=warmup,
             timeout=timeout, skip_preflight="no-preflight" in flags,
             retries=retries, duration=duration,
@@ -162,7 +170,8 @@ def _replay(argv: Sequence[str]) -> int:
     except ValueError as e:
         return _usage_error(str(e))
     report = lg_report.build_report(
-        result, trace_path=pos[0], target=flags["target"],
+        result, trace_path=pos[0],
+        target=targets[0] if len(targets) == 1 else targets,
     )
     lat = report["latency_ms"]
     occ = report["server"]["occupancy_mean"]
@@ -180,6 +189,12 @@ def _replay(argv: Sequence[str]) -> int:
             f"retries: {report['retried_requests']} of "
             f"{report['requests']} requests needed retries "
             f"({report['attempts_total']} attempts total)"
+        )
+    for t, row in sorted((report.get("per_target") or {}).items()):
+        print(
+            f"  {t}: {row['requests']} requests, ok {row['ok']}, "
+            f"429 {row['rejected_429']}, errors {row['errors']}, "
+            f"p95 {row['p95_ms']}ms"
         )
     if "out" in flags:
         with open(flags["out"], "w", encoding="utf-8") as f:

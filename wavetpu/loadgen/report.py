@@ -159,6 +159,33 @@ def build_report(result, trace_path: Optional[str] = None,
         ),
     }
 
+    # Per-target breakdown (repeated --target, i.e. a fleet driven
+    # without a router in front): which replica served what, and which
+    # one the failures came from - a fleet drill must attribute, not
+    # average.  Omitted for the single-target report (no new field to
+    # confuse old baselines).
+    per_target: Optional[Dict[str, dict]] = None
+    target_urls = sorted({o.target for o in outs if o.target})
+    if len(getattr(result, "targets", []) or []) > 1 or \
+            len(target_urls) > 1:
+        per_target = {}
+        for t in sorted(set(getattr(result, "targets", []) or [])
+                        | set(target_urls)):
+            sub = [o for o in outs if o.target == t]
+            t_ok = sum(1 for o in sub if o.status == 200)
+            t_rej = sum(1 for o in sub if o.status == 429)
+            row = {
+                "requests": len(sub),
+                "ok": t_ok,
+                "rejected_429": t_rej,
+                "errors": len(sub) - t_ok - t_rej,
+                "retried_requests": sum(
+                    1 for o in sub if o.attempts > 1
+                ),
+            }
+            row.update(_pcts([o.latency_s * 1e3 for o in sub]))
+            per_target[t] = row
+
     slowest = sorted(outs, key=lambda o: -o.latency_s)[:5]
     report = {
         "loadgen_report": True,
@@ -203,6 +230,9 @@ def build_report(result, trace_path: Optional[str] = None,
             for o in slowest
         ],
     }
+    if per_target is not None:
+        report["per_target"] = per_target
+        report["targets"] = list(getattr(result, "targets", []) or [])
     if meta:
         report["meta"] = meta
     return report

@@ -40,7 +40,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Union
 
 
 class PreflightError(RuntimeError):
@@ -153,18 +153,31 @@ class RequestOutcome:
     )
     error: Optional[str] = None
     attempts: int = 1
+    target: str = ""       # which --target URL served this request
 
 
 @dataclasses.dataclass
 class ReplayResult:
     outcomes: List[RequestOutcome]
     warmup_outcomes: List[RequestOutcome]
-    metrics_before: Dict[str, float]
-    metrics_after: Dict[str, float]
+    metrics_before: Dict[str, float]   # summed across targets
+    metrics_after: Dict[str, float]    # summed across targets
     wall_seconds: float
     mode: str
     concurrency: int
     speed: float
+    targets: List[str] = dataclasses.field(default_factory=list)
+
+
+def sum_metrics(cuts: Sequence[Dict[str, float]]) -> Dict[str, float]:
+    """Sample-wise sum of several /metrics cuts - the fleet view of N
+    replicas' counters (deltas of a sum = sum of deltas, so the report
+    layer's delta math is unchanged)."""
+    out: Dict[str, float] = {}
+    for cut in cuts:
+        for name, value in cut.items():
+            out[name] = out.get(name, 0.0) + value
+    return out
 
 
 def _post_one(base_url: str, index: int, rec: dict, rid: str,
@@ -184,6 +197,7 @@ def _post_one(base_url: str, index: int, rec: dict, rid: str,
                 out.headers.get("Server-Timing")
             ),
             error=out.error, attempts=out.attempts,
+            target=base_url.rstrip("/"),
         )
     body = json.dumps(rec["body"]).encode()
     req = urllib.request.Request(
@@ -213,6 +227,7 @@ def _post_one(base_url: str, index: int, rec: dict, rid: str,
         index=index, scenario=rec.get("scenario", "?"), request_id=rid,
         status=status, latency_s=time.perf_counter() - t0,
         t_sent=t_sent, server_timing=timing, error=err,
+        target=base_url.rstrip("/"),
     )
 
 
@@ -245,7 +260,7 @@ def extend_for_duration(records: Sequence[dict], duration: float,
 
 
 def replay(
-    base_url: str,
+    base_url: Union[str, Sequence[str]],
     records: Sequence[dict],
     mode: str = "open",
     concurrency: int = 4,
@@ -269,7 +284,15 @@ def replay(
     `duration` turns the replay into a SOAK: the trace loops until the
     wall-clock budget elapses (open loop re-offsets each lap's
     timestamps; closed loop cycles the records), still reported as
-    replay-window deltas like any run."""
+    replay-window deltas like any run.
+
+    `base_url` may be a LIST of targets (repeated `--target`): requests
+    round-robin across them - the no-router way to drive a fleet of
+    replicas directly.  Every target is preflighted; warmup serves each
+    tier at EVERY target (one replica warm is not the fleet warm); the
+    bracketing /metrics cuts are summed sample-wise across targets so
+    the report's delta math sees the fleet as one server.  Outcomes
+    carry `target` for the per-replica breakdown."""
     if mode not in ("open", "closed"):
         raise ValueError(f"mode must be open|closed, got {mode!r}")
     if concurrency < 1:
@@ -280,21 +303,33 @@ def replay(
         raise ValueError(f"retries must be >= 0, got {retries}")
     if duration is not None and duration <= 0:
         raise ValueError(f"duration must be > 0, got {duration}")
+    if isinstance(base_url, str):
+        targets = [base_url.rstrip("/")]
+    else:
+        targets = [u.rstrip("/") for u in base_url]
+    if not targets:
+        raise ValueError("need at least one target")
     records = list(records)
     if not records:
         raise ValueError("empty trace")
     if not skip_preflight:
-        preflight(base_url)
+        for t in targets:
+            preflight(t)
     if run_tag is None:
         # Unique enough across replays against one server; hex keeps it
         # inside the server's sanitized request-id alphabet.
         run_tag = f"{int(time.time() * 1e3) & 0xFFFFFFFF:x}"
-    client = None
+    clients: Dict[str, object] = {}
     if retries > 0:
         from wavetpu.client import WavetpuClient
 
-        client = WavetpuClient(base_url, retries=retries,
-                               timeout=timeout)
+        clients = {
+            t: WavetpuClient(t, retries=retries, timeout=timeout)
+            for t in targets
+        }
+
+    def _target(i: int) -> str:
+        return targets[i % len(targets)]
 
     warmup_outcomes: List[RequestOutcome] = []
     if warmup > 0:
@@ -302,19 +337,20 @@ def replay(
         wi = 0
         for rec in records:
             tier = rec.get("scenario", "?")
-            if tier in seen or len(warmup_outcomes) >= warmup:
+            if tier in seen or len(seen) >= warmup:
                 continue
             seen.add(tier)
-            warmup_outcomes.append(_post_one(
-                base_url, wi, rec, _mint_rid(run_tag + "w", wi), 0.0,
-                timeout, client,
-            ))
-            wi += 1
+            for t in targets:
+                warmup_outcomes.append(_post_one(
+                    t, wi, rec, _mint_rid(run_tag + "w", wi), 0.0,
+                    timeout, clients.get(t),
+                ))
+                wi += 1
 
     if duration is not None and mode == "open":
         records = extend_for_duration(records, duration, speed)
 
-    metrics_before = scrape_metrics(base_url)
+    metrics_before = sum_metrics([scrape_metrics(t) for t in targets])
     t_start = time.perf_counter()
 
     if duration is not None and mode == "closed":
@@ -331,10 +367,12 @@ def replay(
                 with lock:
                     i = nxt["i"]
                     nxt["i"] = i + 1
+                t = _target(i)
                 out = _post_one(
-                    base_url, i, records[i % len(records)],
+                    t, i, records[i % len(records)],
                     _mint_rid(run_tag, i),
-                    time.perf_counter() - t_start, timeout, client,
+                    time.perf_counter() - t_start, timeout,
+                    clients.get(t),
                 )
                 with lock:
                     soak.append(out)
@@ -352,17 +390,20 @@ def replay(
         return ReplayResult(
             outcomes=done, warmup_outcomes=warmup_outcomes,
             metrics_before=metrics_before,
-            metrics_after=scrape_metrics(base_url),
+            metrics_after=sum_metrics(
+                [scrape_metrics(t) for t in targets]
+            ),
             wall_seconds=time.perf_counter() - t_start, mode=mode,
-            concurrency=concurrency, speed=speed,
+            concurrency=concurrency, speed=speed, targets=targets,
         )
 
     outcomes: List[Optional[RequestOutcome]] = [None] * len(records)
 
     def fire(i: int, rec: dict) -> None:
+        t = _target(i)
         outcomes[i] = _post_one(
-            base_url, i, rec, _mint_rid(run_tag, i),
-            time.perf_counter() - t_start, timeout, client,
+            t, i, rec, _mint_rid(run_tag, i),
+            time.perf_counter() - t_start, timeout, clients.get(t),
         )
 
     if mode == "open":
@@ -401,12 +442,13 @@ def replay(
             th.join(timeout * len(records) + 30.0)
 
     wall = time.perf_counter() - t_start
-    metrics_after = scrape_metrics(base_url)
+    metrics_after = sum_metrics([scrape_metrics(t) for t in targets])
     done = [
         o if o is not None else RequestOutcome(
             index=i, scenario=records[i].get("scenario", "?"),
             request_id=_mint_rid(run_tag, i), status=0,
             latency_s=timeout, t_sent=0.0, error="never completed",
+            target=_target(i),
         )
         for i, o in enumerate(outcomes)
     ]
@@ -414,5 +456,5 @@ def replay(
         outcomes=done, warmup_outcomes=warmup_outcomes,
         metrics_before=metrics_before, metrics_after=metrics_after,
         wall_seconds=wall, mode=mode, concurrency=concurrency,
-        speed=speed,
+        speed=speed, targets=targets,
     )

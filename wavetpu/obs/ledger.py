@@ -57,49 +57,17 @@ LEDGER_FILENAME = "compile_ledger.jsonl"
 
 MANIFEST_FLAG = "wavetpu_warmup_manifest"
 
-# The ProgramKey field order (serve/engine.py) - kept here so the
-# stdlib-only report tool can canonicalize keys without importing the
-# engine (which imports jax).
-KEY_FIELDS = (
-    "N", "Lx", "Ly", "Lz", "T", "timesteps", "scheme", "path", "k",
-    "dtype", "with_field", "compute_errors", "batch", "mesh",
+# The key canonicalization (KEY_FIELDS order, normalize/canonical,
+# ProgramKey <-> JSON-dict round trip) moved to `wavetpu.progkey` when
+# the fleet router joined the consumers; re-exported here so existing
+# callers (and ledger files on disk) see no change.
+from wavetpu.progkey import (  # noqa: E402,F401
+    KEY_FIELDS,
+    canonical_key,
+    key_from_program_key,
+    normalize_key,
+    program_key_from_dict,
 )
-
-
-def normalize_key(key: dict) -> dict:
-    """A JSON-stable key dict: ProgramKey field order, mesh as a list
-    (JSON has no tuples), unknown fields rejected loudly."""
-    unknown = set(key) - set(KEY_FIELDS)
-    if unknown:
-        raise ValueError(f"unknown ProgramKey fields {sorted(unknown)}")
-    out = {}
-    for f in KEY_FIELDS:
-        v = key.get(f)
-        if f == "mesh" and v is not None:
-            v = [int(x) for x in v]
-        out[f] = v
-    return out
-
-
-def canonical_key(key: dict) -> str:
-    return json.dumps(normalize_key(key), sort_keys=True)
-
-
-def key_from_program_key(pk) -> dict:
-    """A serve.engine.ProgramKey (duck-typed: any NamedTuple with
-    `_asdict`) as the ledger's JSON key dict."""
-    return normalize_key(dict(pk._asdict()))
-
-
-def program_key_from_dict(d: dict):
-    """The round-trip half: a ledger/manifest key dict back into a
-    `serve.engine.ProgramKey` (lazy import - the engine pulls jax)."""
-    from wavetpu.serve.engine import ProgramKey
-
-    d = normalize_key(d)
-    if d["mesh"] is not None:
-        d["mesh"] = tuple(d["mesh"])
-    return ProgramKey(**d)
 
 
 def solo_key(problem, scheme: str, path: str, k: int, dtype: str,

@@ -87,7 +87,8 @@ from concurrent.futures import TimeoutError as FuturesTimeoutError
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence, Tuple
 
-from wavetpu.core.problem import Problem, parse_length
+from wavetpu import progkey
+from wavetpu.core.problem import Problem
 from wavetpu.obs import tracing
 
 _USAGE = (
@@ -140,102 +141,44 @@ def _c2_preset(problem: Problem, spec: str):
     return stencil_ref.make_preset_c2tau2_field(problem, spec)
 
 
+def _jax_platform() -> str:
+    import jax
+
+    return jax.default_backend()
+
+
 def parse_solve_request(body: dict, default_kernel: str = "auto"):
     """Validate a POST /solve body into a SolveRequest (ValueError on any
-    bad field - mapped to HTTP 400)."""
+    bad field - mapped to HTTP 400).
+
+    The program-identity half (geometry, scheme/path/k/dtype/mesh-shape
+    checks) is the shared `wavetpu.progkey.identity_from_body` - the
+    SAME derivation the fleet router uses for affinity routing, so the
+    key the engine caches under and the key the router routes by cannot
+    drift.  This function layers on what needs a backend: device-count
+    checks, c2-field preset construction, and lane validation."""
     from wavetpu.ensemble.batched import LaneSpec
     from wavetpu.serve.scheduler import SolveRequest
 
-    if not isinstance(body, dict):
-        raise ValueError("request body must be a JSON object")
-    if "N" not in body:
-        raise ValueError("missing required field N")
-    problem = Problem(
-        N=int(body["N"]),
-        Np=int(body.get("Np", 1)),
-        Lx=parse_length(body.get("Lx", 1.0)),
-        Ly=parse_length(body.get("Ly", 1.0)),
-        Lz=parse_length(body.get("Lz", 1.0)),
-        T=float(body.get("T", 1.0)),
-        timesteps=int(body.get("timesteps", 20)),
+    ident = progkey.identity_from_body(
+        body, default_kernel, platform=_jax_platform
     )
-    scheme = body.get("scheme", "standard")
-    if scheme not in ("standard", "compensated"):
-        raise ValueError(
-            f"scheme must be standard|compensated, got {scheme!r}"
-        )
-    dtype_name = body.get("dtype", "f32")
-    if dtype_name not in ("f32", "f64", "bf16"):
-        raise ValueError(f"dtype must be f32|f64|bf16, got {dtype_name!r}")
-    kernel = body.get("kernel", default_kernel)
-    if kernel not in ("auto", "roll", "pallas"):
-        raise ValueError(
-            f"kernel must be auto|roll|pallas, got {kernel!r}"
-        )
-    fuse_steps = int(body.get("fuse_steps", 1))
-    if fuse_steps < 1:
-        raise ValueError(f"fuse_steps must be >= 1, got {fuse_steps}")
-    if kernel == "auto":
-        import jax
-
-        from wavetpu.cli import resolve_kernel
-
-        kernel = resolve_kernel("auto", jax.default_backend())
-    if fuse_steps > 1:
-        if kernel == "roll":
-            raise ValueError("fuse_steps needs the pallas kernel")
-        path = "kfused"
-    else:
-        path = kernel
+    problem = ident.problem
     stop = body.get("steps")
     stop = None if stop is None else int(stop)
     field = None
     if body.get("c2_field"):
         field = _c2_preset(problem, str(body["c2_field"]))
     phase = float(body.get("phase", 2.0 * 3.141592653589793))
-    if scheme == "compensated" and field is not None:
-        # Compensated batches are constant-speed only (the field is not
-        # wired through the compensated vmapped core); reject here so
-        # the client gets a 400, not a batch-time 500.  Shifted phases
-        # DO batch on the compensated scheme (analytic bootstrap).
-        raise ValueError(
-            "scheme=compensated does not serve c2_field requests"
-        )
-    if scheme == "compensated" and dtype_name == "bf16":
-        # Same 400-not-500 reasoning: the compensated scheme requires
-        # an f32/f64 carrier (EnsembleSolver would refuse at build).
-        raise ValueError(
-            "scheme=compensated requires f32/f64 state (bf16 "
-            "representation error dominates what compensation recovers)"
-        )
-    mesh = body.get("mesh")
+    mesh = ident.mesh
     if mesh is not None:
         import jax
 
-        mesh = tuple(int(m) for m in mesh)
-        if len(mesh) != 3 or any(m < 1 for m in mesh):
-            raise ValueError(
-                f"mesh must be three positive ints [MX, MY, MZ], "
-                f"got {body.get('mesh')!r}"
-            )
         n_dev = mesh[0] * mesh[1] * mesh[2]
         if n_dev > len(jax.devices()):
             raise ValueError(
                 f"mesh {mesh} needs {n_dev} devices, only "
                 f"{len(jax.devices())} available"
-            )
-        if scheme == "compensated":
-            raise ValueError(
-                "sharded x batched serves the standard scheme only"
-            )
-        if fuse_steps > 1:
-            raise ValueError(
-                "sharded x batched does not take fuse_steps (the "
-                "sharded lane marches the 1-step kernel)"
-            )
-        if field is not None:
-            raise ValueError(
-                "sharded x batched does not serve c2_field requests"
             )
     lane = LaneSpec(phase=phase, stop_step=stop, c2tau2_field=field)
     # Surface lane-level errors (bad stop/k alignment) at parse time so
@@ -243,16 +186,16 @@ def parse_solve_request(body: dict, default_kernel: str = "auto"):
     if mesh is not None:
         from wavetpu.ensemble.sharded import _validate as _validate_sh
 
-        _validate_sh(problem, [lane], path, compute_errors=False)
+        _validate_sh(problem, [lane], ident.path, compute_errors=False)
     else:
         from wavetpu.ensemble.batched import _validate
 
-        _validate(problem, [lane], path,
-                  fuse_steps if path == "kfused" else 2,
-                  compute_errors=False, scheme=scheme)
+        _validate(problem, [lane], ident.path,
+                  ident.k if ident.path == "kfused" else 2,
+                  compute_errors=False, scheme=ident.scheme)
     return SolveRequest(
-        problem=problem, lane=lane, scheme=scheme, path=path,
-        k=fuse_steps if path == "kfused" else 1, dtype_name=dtype_name,
+        problem=problem, lane=lane, scheme=ident.scheme, path=ident.path,
+        k=ident.k, dtype_name=ident.dtype,
         mesh_shape=mesh,
     )
 
@@ -311,17 +254,24 @@ def sanitize_request_id(raw: Optional[str]) -> Optional[str]:
     return raw
 
 
-def server_timing_header(timing: dict, total_s: float) -> str:
+def server_timing_header(timing: dict, total_s: float,
+                         warm: Optional[str] = None) -> str:
     """RFC-style `Server-Timing` value from the scheduler's per-request
     attribution: queue/compile/execute are the ADDITIVE wall components
     (their sum ~= total up to parse/serialize overhead - the 10%
     contract tests/test_serve.py pins), padding is the informational
-    masked-lane share of execute, total is the server-measured wall."""
+    masked-lane share of execute, total is the server-measured wall.
+    `warm` (the engine's true/disk/false/fallback program-source label)
+    rides as a desc-only entry - the fleet router reads it off each
+    response to learn which replica holds which program without an
+    extra /metrics round trip."""
     parts = []
     for name, key in (("queue", "queue_s"), ("compile", "compile_s"),
                       ("execute", "execute_s"), ("padding", "padding_s")):
         parts.append(f"{name};dur={timing.get(key, 0.0) * 1e3:.3f}")
     parts.append(f"total;dur={total_s * 1e3:.3f}")
+    if warm is not None:
+        parts.append(f"warm;desc={warm}")
     return ", ".join(parts)
 
 
@@ -363,9 +313,39 @@ class ServerState:
         # programs exist and pulls it BEFORE drain kills requests.
         self.warming = False
         self.warmup_error: Optional[str] = None
+        # Lazily resolved jax.default_backend(), cached so /healthz
+        # polls (the fleet router's membership loop) never re-query it;
+        # the router uses it to resolve kernel=auto the same way this
+        # replica will.
+        self.backend: Optional[str] = None
+        self._drain_lock = threading.Lock()
+        self._drain_started = False
+
+    def begin_drain(self, httpd) -> bool:
+        """Graceful drain, shared by SIGTERM/SIGINT and POST
+        /admin/drain: refuse new /solve (503 + Retry-After) immediately
+        and stop the accept loop from a daemon thread (shutdown() joins
+        serve_forever, so it must never run on a handler thread
+        in-line).  Idempotent; returns False when already draining."""
+        with self._drain_lock:
+            first = not self._drain_started
+            self._drain_started = True
+            self.draining = True
+        if first:
+            threading.Thread(target=httpd.shutdown, daemon=True).start()
+        return first
 
 
 class _Handler(BaseHTTPRequestHandler):
+    # HTTP/1.1 = persistent connections: the fleet router and the
+    # keep-alive WavetpuClient reuse one socket across requests instead
+    # of paying a TCP handshake each (BaseHTTPRequestHandler defaults
+    # to 1.0/close).  Safe because _send_text is the single send path
+    # and always sets Content-Length; responses that skip reading the
+    # request body send `Connection: close` so leftover bytes can never
+    # be parsed as the next request on the same socket.
+    protocol_version = "HTTP/1.1"
+
     # quiet by default; the scheduler's numbers live in /metrics
     def log_message(self, fmt, *args):  # noqa: D102
         pass
@@ -373,6 +353,17 @@ class _Handler(BaseHTTPRequestHandler):
     @property
     def state(self) -> ServerState:
         return self.server.wavetpu_state
+
+    def _backend(self) -> Optional[str]:
+        st = self.state
+        if st.backend is None:
+            try:
+                import jax
+
+                st.backend = jax.default_backend()
+            except Exception:
+                return None
+        return st.backend
 
     def _send(self, code: int, payload: dict,
               headers: Optional[dict] = None) -> None:
@@ -424,6 +415,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "memory_peak_bytes": (
                     None if mem is None else mem["peak_bytes"]
                 ),
+                "backend": self._backend(),
             }
             if self.state.warmup_error is not None:
                 payload["warmup_error"] = self.state.warmup_error
@@ -462,8 +454,22 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(404, {"status": "error", "error": "not found"})
 
     def do_POST(self) -> None:  # noqa: N802
+        if self.path == "/admin/drain":
+            # HTTP-equivalent of SIGTERM, for the `wavetpu fleet roll`
+            # driver: flip draining (healthz ready -> false, new /solve
+            # -> 503 + Retry-After) and stop the accept loop; queued
+            # work flushes to completion exactly like the signal path.
+            # Idempotent - a second call reports already_draining.
+            first = self.state.begin_drain(self.server)
+            self._send(200, {
+                "status": "ok",
+                "draining": True,
+                "already_draining": not first,
+            }, {"Connection": "close"})
+            return
         if self.path != "/solve":
-            self._send(404, {"status": "error", "error": "not found"})
+            self._send(404, {"status": "error", "error": "not found"},
+                       {"Connection": "close"})
             return
         # Chaos seam: connection drop - close the socket with no
         # response at all, the failure mode a crashed proxy or a
@@ -512,12 +518,15 @@ class _Handler(BaseHTTPRequestHandler):
 
         st = self.state
         if st.draining:
+            # Connection: close because the request body is never read
+            # on this path - leftover bytes on a kept-alive socket
+            # would be parsed as the next request.
             st.metrics.observe_response(False)
             return 503, {
                 "status": "error",
                 "error": "server draining (shutting down)",
                 "retriable": True,
-            }, {"Retry-After": "2"}
+            }, {"Retry-After": "2", "Connection": "close"}
         t0 = time.monotonic()
         try:
             length = int(self.headers.get("Content-Length", "0") or 0)
@@ -532,7 +541,7 @@ class _Handler(BaseHTTPRequestHandler):
             return 400, {
                 "status": "error",
                 "error": "malformed Content-Length header",
-            }, {}
+            }, {"Connection": "close"}
         if st.max_body_bytes is not None and length > st.max_body_bytes:
             # Refused before the body is even read: an oversized upload
             # must not be buffered just to be thrown away.
@@ -673,7 +682,8 @@ class _Handler(BaseHTTPRequestHandler):
         timing = batch_info.get("timing")
         if st.server_timing and timing is not None:
             headers["Server-Timing"] = server_timing_header(
-                timing, time.monotonic() - t0
+                timing, time.monotonic() - t0,
+                warm=batch_info.get("warm"),
             )
         if lane_error is not None:
             st.metrics.observe_response(False)
@@ -985,9 +995,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         def _shutdown(signum, frame):
             # Graceful drain: refuse new /solve (503) immediately, stop
             # the accept loop, and let the finally block flush what is
-            # queued.
-            state.draining = True
-            threading.Thread(target=httpd.shutdown, daemon=True).start()
+            # queued.  Shared with POST /admin/drain (fleet roll).
+            state.begin_drain(httpd)
 
         signal.signal(signal.SIGTERM, _shutdown)
         signal.signal(signal.SIGINT, _shutdown)

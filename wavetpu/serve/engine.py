@@ -49,7 +49,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import OrderedDict
-from typing import List, NamedTuple, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 from wavetpu.core.problem import Problem
 from wavetpu.ensemble import batched as ensemble
@@ -57,45 +57,15 @@ from wavetpu.ensemble import sharded as ens_sharded
 from wavetpu.obs import ledger as compile_ledger
 from wavetpu.obs import perf, tracing
 from wavetpu.obs.registry import MetricsRegistry
+from wavetpu.progkey import ProgramKey
 from wavetpu.run import faults, health
 from wavetpu.serve.resilience import CircuitBreaker, QuarantinedError
 
 
-class ProgramKey(NamedTuple):
-    """Identity of one compiled batched program (the cache key).
-
-    `mesh` is None for single-device programs, or the (MX, MY, MZ) mesh
-    shape of a sharded x batched program (ensemble/sharded.py) - a
-    (mesh, batch-bucket) pair is its own compiled executable."""
-
-    N: int
-    Lx: float
-    Ly: float
-    Lz: float
-    T: float
-    timesteps: int
-    scheme: str
-    path: str
-    k: int
-    dtype: str
-    with_field: bool
-    compute_errors: bool
-    batch: int
-    mesh: Optional[Tuple[int, int, int]] = None
-
-    @classmethod
-    def for_batch(cls, problem: Problem, scheme: str, path: str, k: int,
-                  dtype_name: str, with_field: bool, compute_errors: bool,
-                  batch: int,
-                  mesh: Optional[Tuple[int, int, int]] = None
-                  ) -> "ProgramKey":
-        return cls(
-            N=problem.N, Lx=problem.Lx, Ly=problem.Ly, Lz=problem.Lz,
-            T=problem.T, timesteps=problem.timesteps, scheme=scheme,
-            path=path, k=k if path == "kfused" else 1, dtype=dtype_name,
-            with_field=with_field, compute_errors=compute_errors,
-            batch=batch, mesh=None if mesh is None else tuple(mesh),
-        )
+# ProgramKey moved to `wavetpu.progkey` (the fleet router derives the
+# same identity without importing jax); imported above and still
+# exported from this module - `from wavetpu.serve.engine import
+# ProgramKey` keeps working everywhere.
 
 
 class ServeEngine:
@@ -496,6 +466,21 @@ class ServeEngine:
                 "disk_hits": self.disk_hits,
                 "evictions": self.evictions,
                 "keys": [list(k) for k in self._programs],
+                # ProgramKey dicts the fleet router's affinity table
+                # bootstraps from on a cold poll: programs compiled in
+                # THIS process (memory LRU) plus .wtpc entries this
+                # replica could adopt without a fresh compile (disk,
+                # own-fingerprint only).
+                "warm_keys": {
+                    "memory": [
+                        compile_ledger.key_from_program_key(k)
+                        for k in self._programs
+                    ],
+                    "disk": (
+                        self.progcache.entry_keys()
+                        if self.progcache is not None else []
+                    ),
+                },
                 "fallbacks": dict(self.fallbacks),
                 # Disk tier (serve/progcache.py): entry count/bytes,
                 # event counts, and the once-per-process AOT

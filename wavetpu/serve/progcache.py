@@ -60,7 +60,7 @@ import struct
 import sys
 import threading
 import time
-from typing import Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 from wavetpu.obs import ledger as compile_ledger
 
@@ -492,6 +492,41 @@ class ProgramCache:
         if evicted:
             self.count("gc_evict", evicted)
         return evicted
+
+    def entry_keys(self) -> List[dict]:
+        """ProgramKey dicts of every ADOPTABLE disk entry: same-
+        fingerprint `.wtpc` files whose header parses (headers only -
+        no payload read, no pickle).  This is the disk half of the
+        /metrics `program_cache.warm_keys` block the fleet router
+        bootstraps its affinity table from: a replica that has not yet
+        served a tier still attracts its traffic when the shared cache
+        dir lets it adopt the program instead of compiling.  Corrupt or
+        foreign-fingerprint entries are silently skipped (this is
+        advertisement, not adoption - load() keeps the loud path)."""
+        if not self.usable:
+            return []
+        suffix = f"-{self._fp_hash}{ENTRY_SUFFIX}"
+        out: List[dict] = []
+        for path, _size, _mtime in self._entries():
+            if not os.path.basename(path).endswith(suffix):
+                continue
+            try:
+                with open(path, "rb") as f:
+                    if f.read(len(MAGIC)) != MAGIC:
+                        continue
+                    raw_len = f.read(4)
+                    if len(raw_len) != 4:
+                        continue
+                    (hdr_len,) = struct.unpack(">I", raw_len)
+                    if hdr_len > 1 << 20:
+                        continue
+                    header = json.loads(f.read(hdr_len))
+            except Exception:
+                continue
+            key = header.get("key")
+            if isinstance(key, dict):
+                out.append(key)
+        return out
 
     def stats(self) -> dict:
         """The /metrics `program_cache.progcache` block."""
