@@ -651,6 +651,97 @@ def _resilience_row(interp):
         return {"error": "failed; see stderr"}
 
 
+def _preemptible_row(interp):
+    """Preemptible serving's two-sided proof.  (1) Overhead: a long
+    solve marched as fixed-length chunk programs (the serve path past
+    --chunk-threshold, serve/preempt.py ChunkRunner) vs the SAME solve
+    as one monolithic program, best-of-2 walls each - the checkpoint
+    machinery must cost <= 5% when nothing preempts (state only ever
+    lives in the in-flight march; the store is written on preemption,
+    never per chunk).  (2) Interleaving: short requests submitted while
+    a long march is in flight - the scheduler runs ONE chunk per worker
+    pass, so each short waits at most ~one chunk on the chunked arm but
+    queues behind the WHOLE solve on the monolithic arm; the row
+    records both p95s and their ratio."""
+    import threading  # noqa: F401  (parity with sibling rows' pattern)
+    import time
+    import traceback
+
+    from wavetpu.core.problem import Problem
+    from wavetpu.ensemble.batched import LaneSpec
+    from wavetpu.serve.engine import ServeEngine
+    from wavetpu.serve.scheduler import DynamicBatcher, SolveRequest
+
+    n, long_steps, short_steps, chunk = (
+        (16, 240, 6, 48) if interp else (128, 400, 20, 80)
+    )
+    long_p = Problem(N=n, timesteps=long_steps)
+    short_p = Problem(N=n, timesteps=short_steps)
+
+    def _req(p):
+        return SolveRequest(problem=p, lane=LaneSpec())
+
+    def measure(chunked):
+        eng = ServeEngine(bucket_sizes=(1,), interpret=interp)
+        kw = (dict(chunk_threshold=short_steps + 1, chunk_steps=chunk)
+              if chunked else {})
+        b = DynamicBatcher(eng, max_wait=0.002, **kw)
+        try:
+            # warm both tiers (boot + every chunk length on the
+            # chunked arm; the one monolithic program on the other)
+            b.submit(_req(long_p)).result(600)
+            b.submit(_req(short_p)).result(600)
+            walls = []
+            for _ in range(2):
+                t0 = time.perf_counter()
+                b.submit(_req(long_p)).result(600)
+                walls.append(time.perf_counter() - t0)
+            # shorts behind an in-flight long march, submitted
+            # sequentially: distinct bucket keys, so nothing coalesces
+            fut = b.submit(_req(long_p))
+            lats = []
+            for _ in range(6):
+                t0 = time.perf_counter()
+                b.submit(_req(short_p)).result(600)
+                lats.append(time.perf_counter() - t0)
+            fut.result(600)
+            lats.sort()
+            p95 = lats[min(len(lats) - 1, int(0.95 * len(lats)))]
+            return min(walls), walls, p95
+        finally:
+            b.close()
+
+    try:
+        wall_c, walls_c, p95_c = measure(chunked=True)
+        wall_m, walls_m, p95_m = measure(chunked=False)
+        n_chunks = -(-long_steps // chunk)
+        return {
+            "long_wall_s_chunked": round(wall_c, 6),
+            "long_wall_s_monolithic": round(wall_m, 6),
+            "long_wall_runs_chunked": [round(w, 6) for w in walls_c],
+            "long_wall_runs_monolithic": [round(w, 6) for w in walls_m],
+            "preemptible_overhead_pct": round(
+                100.0 * (wall_c - wall_m) / wall_m, 2
+            ) if wall_m else None,
+            "short_p95_ms_during_long_chunked": round(p95_c * 1e3, 3),
+            "short_p95_ms_during_long_monolithic": round(p95_m * 1e3, 3),
+            "short_p95_speedup_vs_monolithic": round(
+                p95_m / p95_c, 2
+            ) if p95_c else None,
+            "policy": "best_of_2",
+            "config": (
+                f"N={n} long={long_steps} steps in {n_chunks} chunks of "
+                f"{chunk} vs one monolithic program (overhead bar <= "
+                f"5%); 6 sequential N={n}/{short_steps} shorts behind "
+                f"an in-flight long march per arm (p95 each)"
+            ),
+        }
+    except Exception:
+        print("preemptible sub-benchmark failed:", file=sys.stderr)
+        traceback.print_exc()
+        return {"error": "failed; see stderr"}
+
+
 _COLD_START_CHILD = r"""
 import json, sys, time
 t_proc = time.perf_counter()
@@ -1247,6 +1338,10 @@ def main() -> int:
     # Serving resilience: deadlines + breaker checks live vs a plain
     # twin - the request-path resilience layer's <= 2% happy-path bar.
     subs["resilience"] = _resilience_row(interp)
+    # Preemptible serving: chunked vs monolithic long-solve overhead
+    # (<= 5% bar) + short-request p95 while a long march is in flight
+    # (chunk interleaving vs queueing behind the whole solve).
+    subs["preemptible"] = _preemptible_row(interp)
     # Cold-start: fresh-process time-to-first-solve, empty vs
     # pre-populated persistent program cache (subprocess arms,
     # best-of-2); the restart/autoscale win, bar >= 50% savings.
@@ -1330,6 +1425,15 @@ def main() -> int:
         ),
         "resilience_overhead_pct": subs["resilience"].get(
             "resilience_overhead_pct_vs_plain"
+        ),
+        "preemptible_overhead_pct": subs["preemptible"].get(
+            "preemptible_overhead_pct"
+        ),
+        "preemptible_short_p95_ms": subs["preemptible"].get(
+            "short_p95_ms_during_long_chunked"
+        ),
+        "preemptible_short_p95_speedup": subs["preemptible"].get(
+            "short_p95_speedup_vs_monolithic"
         ),
         "cold_start_savings_pct": subs["cold_start"].get(
             "savings_pct"

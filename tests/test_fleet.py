@@ -31,7 +31,7 @@ from wavetpu.fleet.membership import (
     UP,
     MembershipTable,
 )
-from wavetpu.fleet.router import build_router
+from wavetpu.fleet.router import build_router, load_api_keys
 from wavetpu.fleet import roll as fleet_roll
 from wavetpu.loadgen import report as lg_report
 from wavetpu.loadgen import runner, trace
@@ -332,6 +332,8 @@ class _ScriptedMember:
         self.prom = prom
         self.solve_script = []   # (status, payload, headers) or "drop"
         self.solves = 0
+        self.seen_headers = []   # per /solve attempt: request headers
+        self.seen_bodies = []    # per /solve attempt: raw request body
 
         state = self
 
@@ -383,7 +385,11 @@ class _ScriptedMember:
 
             def do_POST(self):
                 length = int(self.headers.get("Content-Length", 0) or 0)
-                self.rfile.read(length)
+                raw = self.rfile.read(length)
+                if self.path == "/solve":
+                    with state.lock:
+                        state.seen_headers.append(dict(self.headers))
+                        state.seen_bodies.append(raw)
                 if self.path == "/admin/drain":
                     with state.lock:
                         state.draining = True
@@ -430,12 +436,13 @@ def _start_router(member_urls, **kw):
     return httpd, state, f"http://127.0.0.1:{httpd.server_address[1]}"
 
 
-def _post(base, path, body, timeout=30):
+def _post(base, path, body, timeout=30, headers=None):
     import urllib.error
 
     req = urllib.request.Request(
         base + path, data=json.dumps(body).encode(),
-        headers={"Content-Type": "application/json"}, method="POST",
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
     )
     try:
         with urllib.request.urlopen(req, timeout=timeout) as r:
@@ -662,6 +669,193 @@ class TestRouterProxy:
 # ---- real fleet: chaos at one member, absorbed at the router seam ----
 
 
+def _hget(headers: dict, name: str):
+    return {k.lower(): v for k, v in headers.items()}.get(name.lower())
+
+
+class TestRouterDeadlineBudget:
+    """Satellite: the router forwards X-Deadline-Ms DECREMENTED by its
+    own wall, refuses doomed retries below --min-retry-budget-ms, and
+    re-injects a draining member's resume_token into the retried body
+    (the cross-replica solve handoff seam, scripted - no jax)."""
+
+    BODY = {"N": 8, "timesteps": 4}
+
+    def _pin(self, member):
+        """A warm-key advertisement pinning BODY's first pick to
+        `member` (the test needs attempt order deterministic)."""
+        kd = progkey.key_from_program_key(
+            progkey.identity_from_body(
+                self.BODY, platform="cpu"
+            ).program_key(4, True)
+        )
+        member.warm_keys = {"memory": [kd], "disk": []}
+
+    def test_deadline_decremented_and_token_reinjected_on_retry(self):
+        token = "ab" * 32
+        m1, m2 = _ScriptedMember(), _ScriptedMember()
+        self._pin(m1)
+        m1.solve_script = [(503, {
+            "status": "error", "error": "draining: checkpointed",
+            "retriable": True, "resume_token": token,
+        }, {"Retry-After": "1"})]
+        httpd, state, base = _start_router([m1.url, m2.url])
+        try:
+            state.table.poll_once()
+            code, payload, _ = _post(
+                base, "/solve", self.BODY,
+                headers={"X-Deadline-Ms": "200000"},
+            )
+            assert code == 200
+            assert m1.solves == 1 and m2.solves == 1
+            # both attempts carried a budget; the retry's is the
+            # REMAINING budget, never more than the original
+            d1 = float(_hget(m1.seen_headers[0], "X-Deadline-Ms"))
+            d2 = float(_hget(m2.seen_headers[0], "X-Deadline-Ms"))
+            assert 0 < d1 <= 200000
+            assert 0 < d2 <= d1
+            # the drained member's token rode the retry into m2's body
+            retried = json.loads(m2.seen_bodies[0])
+            assert retried["resume_token"] == token
+            snap = state.snapshot()
+            assert snap["resume_handoffs_total"] == 1
+            assert snap["retried_requests"] == 1
+        finally:
+            httpd.shutdown(); httpd.server_close()
+            state.stop_poller()
+            m1.close(); m2.close()
+
+    def test_retry_below_min_budget_surfaces_last_answer(self):
+        m1, m2 = _ScriptedMember(), _ScriptedMember()
+        self._pin(m1)
+        m1.solve_script = [(503, {
+            "status": "error", "error": "draining", "retriable": True,
+        }, {"Retry-After": "1"})]
+        httpd, state, base = _start_router(
+            [m1.url, m2.url], min_retry_budget_ms=10_000_000.0,
+        )
+        try:
+            state.table.poll_once()
+            code, payload, _ = _post(
+                base, "/solve", self.BODY,
+                headers={"X-Deadline-Ms": "200000"},
+            )
+            # remaining budget < the floor: no second attempt, the
+            # 503 stands (still retriable - the CLIENT may have more
+            # budget tomorrow, the router just won't burn it now)
+            assert code == 503
+            assert m1.solves == 1 and m2.solves == 0
+            assert state.snapshot()["budget_stops_total"] == 1
+        finally:
+            httpd.shutdown(); httpd.server_close()
+            state.stop_poller()
+            m1.close(); m2.close()
+
+    def test_budget_burned_router_side_is_a_router_504(self):
+        m1 = _ScriptedMember()
+        httpd, state, base = _start_router([m1.url])
+        try:
+            code, payload, _ = _post(
+                base, "/solve", self.BODY,
+                headers={"X-Deadline-Ms": "0"},
+            )
+            assert code == 504
+            assert "router" in payload["error"]
+            assert m1.solves == 0  # no replica marched doomed work
+        finally:
+            httpd.shutdown(); httpd.server_close()
+            state.stop_poller()
+            m1.close()
+
+    def test_unparseable_budget_forwarded_replica_owns_the_400(self):
+        m1 = _ScriptedMember()
+        httpd, state, base = _start_router([m1.url])
+        try:
+            code, _, _ = _post(
+                base, "/solve", self.BODY,
+                headers={"X-Deadline-Ms": "soon"},
+            )
+            assert code == 200  # scripted member answers; contract is
+            assert m1.solves == 1  # "forwarded, not router-rejected"
+            assert _hget(m1.seen_headers[0], "X-Deadline-Ms") == "soon"
+        finally:
+            httpd.shutdown(); httpd.server_close()
+            state.stop_poller()
+            m1.close()
+
+
+class TestRouterApiKeys:
+    """Satellite carry-over: API keys terminate at the router; the
+    mapped tenant label - never the caller's claim - travels on as
+    X-Wavetpu-Tenant."""
+
+    BODY = {"N": 8, "timesteps": 4}
+
+    def test_load_api_keys_parses_and_validates(self, tmp_path):
+        p = tmp_path / "keys.json"
+        p.write_text(json.dumps({"k1": "acme", "k2": "umbrella"}))
+        assert load_api_keys(str(p)) == {"k1": "acme", "k2": "umbrella"}
+        for bad in (["k1"], {}, {"k": 5}, {"": "t"}, {"k": ""}):
+            p.write_text(json.dumps(bad))
+            with pytest.raises(ValueError):
+                load_api_keys(str(p))
+
+    def test_keys_gate_solve_and_stamp_the_mapped_tenant(self):
+        m = _ScriptedMember()
+        httpd, state, base = _start_router(
+            [m.url], api_keys={"k1": "acme"}
+        )
+        try:
+            # no key / unknown key: 401 with a challenge, nothing
+            # forwarded
+            code, _, headers = _post(base, "/solve", self.BODY)
+            assert code == 401
+            assert _hget(headers, "WWW-Authenticate") == "Bearer"
+            code, _, _ = _post(base, "/solve", self.BODY,
+                               headers={"X-Api-Key": "nope"})
+            assert code == 401
+            assert m.solves == 0
+            # Bearer form; a spoofed tenant claim is REPLACED by the
+            # key's mapped label
+            code, _, _ = _post(base, "/solve", self.BODY, headers={
+                "Authorization": "Bearer k1",
+                "X-Wavetpu-Tenant": "evil",
+            })
+            assert code == 200
+            assert _hget(m.seen_headers[-1], "X-Wavetpu-Tenant") == "acme"
+            # X-Api-Key form
+            code, _, _ = _post(base, "/solve", self.BODY,
+                               headers={"X-Api-Key": "k1"})
+            assert code == 200
+            assert _hget(m.seen_headers[-1], "X-Wavetpu-Tenant") == "acme"
+            snap = state.snapshot()
+            assert snap["auth_rejected_total"] == 2
+            assert snap["requests_per_tenant"] == {"acme": 2}
+            # health stays unauthenticated (probes, fleet tooling)
+            code, _ = _get(base, "/healthz")
+            assert code == 200
+        finally:
+            httpd.shutdown(); httpd.server_close()
+            state.stop_poller()
+            m.close()
+
+    def test_keys_off_passes_the_tenant_header_through(self):
+        m = _ScriptedMember()
+        httpd, state, base = _start_router([m.url])
+        try:
+            code, _, _ = _post(base, "/solve", self.BODY,
+                               headers={"X-Wavetpu-Tenant": "acme"})
+            assert code == 200
+            assert _hget(m.seen_headers[0], "X-Wavetpu-Tenant") == "acme"
+            assert state.snapshot()["requests_per_tenant"] == {
+                "acme": 1
+            }
+        finally:
+            httpd.shutdown(); httpd.server_close()
+            state.stop_poller()
+            m.close()
+
+
 def _start_replica(**kw):
     kw.setdefault("max_wait", 0.02)
     kw.setdefault("default_kernel", "roll")
@@ -822,5 +1016,105 @@ class TestRollingDeployDrill:
             state.stop_poller()
             _stop_replica(h1, s1)
             _stop_replica(h2, s2)
+            if h3 is not None:
+                _stop_replica(h3, s3)
+
+    def test_roll_hands_off_inflight_long_solve(self, tmp_path):
+        """ISSUE tentpole acceptance (drain-roll leg): a chunked long
+        solve is IN FLIGHT at the predecessor when `fleet roll` drains
+        it.  The drain checkpoints the march (503 + resume_token), the
+        router re-injects the token on its member retry, and the
+        successor - sharing --solve-state-dir - resumes from the last
+        completed chunk.  The zero-retry client sees ONE attempt, a
+        200, and a report exactly equal to an unpreempted run's."""
+        state_dir = str(tmp_path / "state")
+        body = {"N": 8, "timesteps": 33}
+        chunk_kw = dict(chunk_threshold=8, chunk_steps=4,
+                        solve_state_dir=state_dir)
+        # every chunk round of the long tier sleeps 0.5s at the
+        # predecessor: the march is still mid-flight when the roll's
+        # drain lands (the successor carries no fault - resumed chunks
+        # run at full speed)
+        plan = faults.parse_serve_spec(
+            "serve-slow-batch:seconds=0.5,timesteps=33"
+        )
+        h1, s1, u1 = _start_replica(fault_plan=plan, **chunk_kw)
+        httpd, state, base = _start_router(
+            [u1], poll_interval_s=0.3, proxy_timeout=120.0,
+        )
+        h3 = s3 = None
+        u3 = None
+        victim = {}
+        roll_result = {}
+        try:
+            # control: the same long solve, unpreempted (also warms
+            # u1's chunk programs, so the victim marches immediately)
+            direct = WavetpuClient(u1, retries=0, timeout=120.0)
+            control = direct.solve(body)
+            assert control.ok, (control.status, control.error)
+            assert control.payload["batch"]["chunked"] is True
+            base_chunks = s1.metrics.snapshot()["chunks_total"]
+
+            def _solve():
+                client = WavetpuClient(base, retries=0, timeout=120.0)
+                victim["out"] = client.solve(body)
+
+            vt = threading.Thread(target=_solve, daemon=True)
+            vt.start()
+            # wait until the victim's march is genuinely mid-flight
+            deadline = time.monotonic() + 30.0
+            while (s1.metrics.snapshot()["chunks_total"] <= base_chunks
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            assert s1.metrics.snapshot()["chunks_total"] > base_chunks
+
+            # successor: clean (no fault plan), same shared state dir
+            h3, s3, u3 = _start_replica(**chunk_kw)
+
+            def _roll():
+                roll_result["rc"] = fleet_roll.roll(
+                    base, old_url=u1, new_url=u3,
+                    spawn_argv=None, manifest_path=None,
+                    timeout_s=60.0, leave_sync=True,
+                    log=lambda *a, **k: None,
+                )
+
+            rt = threading.Thread(target=_roll, daemon=True)
+            rt.start()
+            # a real serve process drains its batcher in main()'s
+            # finally once /admin/drain stops the accept loop; the
+            # in-process replica does that step here
+            deadline = time.monotonic() + 30.0
+            while not s1.draining and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert s1.draining
+            s1.batcher.close(timeout=60.0, drain=True)
+            rt.join(90.0)
+            vt.join(90.0)
+            assert roll_result.get("rc") == 0, roll_result
+            out = victim.get("out")
+            assert out is not None and out.ok, (
+                out and (out.status, out.error, out.payload)
+            )
+            # the handoff was invisible: ONE attempt (zero client
+            # retries), answered by the successor
+            assert out.attempts == 1
+            assert out.headers.get("X-Wavetpu-Member") == u3
+            # exact parity with the unpreempted control: the report's
+            # per-checkpoint error lists are the full float values
+            cr, vr = control.payload["report"], out.payload["report"]
+            assert vr["final_step"] == cr["final_step"] == 33
+            assert vr["abs_errors"] == cr["abs_errors"]
+            assert vr["rel_errors"] == cr["rel_errors"]
+            # the resume really crossed replicas via the shared dir
+            assert out.payload["batch"]["resumed_from"] >= 1
+            assert s1.metrics.snapshot()["preempted_total"] >= 1
+            assert s3.metrics.snapshot()["resumed_total"] == 1
+            assert state.snapshot()["resume_handoffs_total"] == 1
+            assert state.table.get(u1).state == LEFT
+        finally:
+            httpd.shutdown(); httpd.server_close()
+            state.stop_poller()
+            _stop_replica(h1, s1)
             if h3 is not None:
                 _stop_replica(h3, s3)

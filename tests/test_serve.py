@@ -20,8 +20,15 @@ import pytest
 
 from wavetpu.core.problem import Problem
 from wavetpu.ensemble import batched as eb
+from wavetpu.run import faults
 from wavetpu.serve.api import _c2_preset, build_server, parse_solve_request
 from wavetpu.serve.engine import ProgramKey, ServeEngine
+from wavetpu.serve.preempt import SolveStateStore
+from wavetpu.serve.resilience import (
+    DeadlineExceededError,
+    InvalidStateTokenError,
+    PreemptedError,
+)
 from wavetpu.serve.scheduler import (
     DynamicBatcher,
     QueueFullError,
@@ -543,6 +550,237 @@ class TestBoundedQueue:
         b.submit(_req(p)).result(10)
         b.close()
         assert metrics.snapshot()["queue_depth"] == 0
+
+
+class TestPreemptible:
+    """The preemption drill (docs/robustness.md "Preemptible solves"):
+    long solves march CHUNKED through the batcher, interrupted by each
+    of {deadline, worker crash, drain} they resume - via resume token
+    or in-memory progress - and the final state is BITWISE identical to
+    the same solve run unpreempted.  Corrupt tokens 422 cleanly and the
+    circuit breaker never hears about any of it."""
+
+    THRESHOLD = 8
+    CHUNK = 4
+
+    @pytest.fixture(scope="class")
+    def eng(self):
+        # one real CPU engine for the whole class: the chunk programs
+        # compile once, every test after the first runs warm
+        return ServeEngine(bucket_sizes=(1,), interpret=True)
+
+    def _batcher(self, eng, store=None, plan=None, max_wait=0.02):
+        return DynamicBatcher(
+            eng, max_wait=max_wait, fault_plan=plan,
+            chunk_threshold=self.THRESHOLD, chunk_steps=self.CHUNK,
+            state_store=store,
+        )
+
+    def _long(self, timesteps=17):
+        return Problem(N=8, timesteps=timesteps)
+
+    def _control(self, eng, p):
+        """The unpreempted chunked march (the drill's parity baseline)."""
+        b = self._batcher(eng)
+        try:
+            return b.submit(_req(p)).result(120)
+        finally:
+            b.close()
+
+    def test_long_solve_marches_chunked_matching_monolithic(self, eng):
+        p = self._long()
+        res, health, info = self._control(eng, p)
+        assert health is None
+        assert info["chunked"] is True
+        assert info["chunks"] == 4          # ceil(16 / 4)
+        assert info["chunk_len"] == self.CHUNK
+        assert info["resumed_from"] is None
+        assert info["occupancy"] == 1 and info["batched"] is True
+        assert res.final_step == p.timesteps
+        # parity with the monolithic (vmapped, batch-of-1) serve path:
+        # the chunked march is a latency/preemption trade, never an
+        # accuracy one
+        mono, mono_health = eng.solve(p, [eb.LaneSpec()], path="roll")
+        assert mono_health == [None]
+        assert _bitwise(res.u_cur, mono.results[0].u_cur)
+        assert _bitwise(res.u_prev, mono.results[0].u_prev)
+        assert _bitwise(res.abs_errors, mono.results[0].abs_errors)
+
+    def test_short_requests_stay_on_the_batched_path(self, eng):
+        b = self._batcher(eng)
+        try:
+            res, health, info = b.submit(
+                _req(Problem(N=8, timesteps=4))
+            ).result(120)
+            assert health is None
+            assert not info.get("chunked")
+        finally:
+            b.close()
+
+    def test_deadline_preempts_with_token_resume_is_bitwise(
+        self, eng, tmp_path
+    ):
+        p = self._long()
+        control = self._control(eng, p)[0]
+        store = SolveStateStore(str(tmp_path / "state"))
+        # the per-chunk slow injection stretches the march so the
+        # budget expires mid-flight, deterministically
+        plan = faults.parse_serve_spec(
+            f"serve-slow-batch:seconds=0.25,timesteps={p.timesteps}"
+        )
+        b = self._batcher(eng, store=store, plan=plan)
+        try:
+            fut = b.submit(_req(p), deadline=time.monotonic() + 0.4)
+            with pytest.raises(DeadlineExceededError) as ei:
+                fut.result(120)
+            token = ei.value.resume_token
+            assert SolveStateStore.valid_token(token)
+            snap = b.metrics.snapshot()
+            assert snap["preempted_total"] == 1
+        finally:
+            b.close()
+        # resume on a FRESH batcher (same store), no budget this time
+        b2 = self._batcher(eng, store=store)
+        try:
+            req = SolveRequest(
+                problem=p, lane=eb.LaneSpec(), resume_token=token
+            )
+            res, health, info = b2.submit(req).result(120)
+            assert health is None
+            assert info["resumed_from"] >= 1
+            assert b2.metrics.snapshot()["resumed_total"] == 1
+        finally:
+            b2.close()
+        assert _bitwise(res.u_cur, control.u_cur)
+        assert _bitwise(res.u_prev, control.u_prev)
+        assert _bitwise(res.abs_errors, control.abs_errors)
+
+    def test_worker_crash_resumes_march_zero_client_errors(self, eng):
+        p = self._long()
+        control = self._control(eng, p)[0]
+        plan = faults.parse_serve_spec(
+            f"serve-chunk-crash:timesteps={p.timesteps},count=1"
+        )
+        b = self._batcher(eng, plan=plan)
+        try:
+            # the crash escapes the worker mid-march; the supervisor
+            # restarts it and the item resumes from its in-memory
+            # progress - the CLIENT never sees an error
+            res, health, info = b.submit(_req(p)).result(120)
+            assert health is None
+            assert res.final_step == p.timesteps
+            snap = b.metrics.snapshot()
+            assert snap["worker_restarts_total"] == 1
+            assert snap["resumed_total"] == 1
+        finally:
+            b.close()
+        assert _bitwise(res.u_cur, control.u_cur)
+        assert _bitwise(res.abs_errors, control.abs_errors)
+
+    def test_drain_checkpoints_and_successor_resumes_bitwise(
+        self, eng, tmp_path
+    ):
+        p = self._long()
+        control = self._control(eng, p)[0]
+        state_dir = str(tmp_path / "state")
+        store = SolveStateStore(state_dir)
+        plan = faults.parse_serve_spec(
+            f"serve-slow-batch:seconds=0.4,timesteps={p.timesteps}"
+        )
+        b = self._batcher(eng, store=store, plan=plan)
+        fut = b.submit(_req(p))
+        # wait until the march is genuinely in flight, then drain
+        deadline = time.monotonic() + 60.0
+        while (b.metrics.snapshot()["chunks_total"] == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        assert b.metrics.snapshot()["chunks_total"] >= 1
+        b.close(timeout=60.0, drain=True)
+        with pytest.raises(PreemptedError) as ei:
+            fut.result(0)
+        token = ei.value.resume_token
+        assert SolveStateStore.valid_token(token)
+        # the "successor replica": a DIFFERENT engine sharing only the
+        # state dir (the cross-replica handoff surface)
+        eng2 = ServeEngine(bucket_sizes=(1,), interpret=True)
+        b2 = self._batcher(eng2, store=SolveStateStore(state_dir))
+        try:
+            req = SolveRequest(
+                problem=p, lane=eb.LaneSpec(), resume_token=token
+            )
+            res, health, info = b2.submit(req).result(120)
+            assert health is None
+            assert info["resumed_from"] >= 1
+        finally:
+            b2.close()
+        assert _bitwise(res.u_cur, control.u_cur)
+        assert _bitwise(res.u_prev, control.u_prev)
+        assert _bitwise(res.abs_errors, control.abs_errors)
+
+    def test_corrupt_token_422s_cleanly_breaker_never_hears(
+        self, eng, tmp_path
+    ):
+        p = self._long()
+        store = SolveStateStore(str(tmp_path / "state"))
+        # mint a genuine token, then corrupt its bytes on disk
+        # (serve-handoff-corrupt truncates it at load time)
+        plan = faults.parse_serve_spec(
+            f"serve-slow-batch:seconds=0.25,timesteps={p.timesteps}"
+        )
+        b = self._batcher(eng, store=store, plan=plan)
+        try:
+            fut = b.submit(_req(p), deadline=time.monotonic() + 0.4)
+            with pytest.raises(DeadlineExceededError) as ei:
+                fut.result(120)
+            token = ei.value.resume_token
+        finally:
+            b.close()
+        corrupt = faults.parse_serve_spec("serve-handoff-corrupt:count=1")
+        b2 = self._batcher(eng, store=store, plan=corrupt)
+        try:
+            req = SolveRequest(
+                problem=p, lane=eb.LaneSpec(), resume_token=token
+            )
+            with pytest.raises(InvalidStateTokenError,
+                               match="content verification"):
+                b2.submit(req).result(120)
+            # an unknown (never-minted) token is the same clean 422
+            req2 = SolveRequest(
+                problem=p, lane=eb.LaneSpec(), resume_token="0" * 64
+            )
+            with pytest.raises(InvalidStateTokenError, match="not found"):
+                b2.submit(req2).result(120)
+        finally:
+            b2.close()
+        # neither rejection fed the engine's circuit breaker
+        assert eng.breaker_stats()["open"] == 0
+
+    def test_token_identity_mismatch_is_rejected(self, eng, tmp_path):
+        store = SolveStateStore(str(tmp_path / "state"))
+        p = self._long()
+        plan = faults.parse_serve_spec(
+            f"serve-slow-batch:seconds=0.25,timesteps={p.timesteps}"
+        )
+        b = self._batcher(eng, store=store, plan=plan)
+        try:
+            fut = b.submit(_req(p), deadline=time.monotonic() + 0.4)
+            with pytest.raises(DeadlineExceededError) as ei:
+                fut.result(120)
+            token = ei.value.resume_token
+        finally:
+            b.close()
+        # replaying the token against a DIFFERENT solve is a clean 422
+        other = Problem(N=8, timesteps=13)
+        b2 = self._batcher(eng, store=store)
+        try:
+            req = SolveRequest(
+                problem=other, lane=eb.LaneSpec(), resume_token=token
+            )
+            with pytest.raises(InvalidStateTokenError,
+                               match="does not match"):
+                b2.submit(req).result(120)
+        finally:
+            b2.close()
 
 
 class TestMetricsRegistryIntegration:
@@ -1225,6 +1463,102 @@ class TestHTTP:
 
 
 # ---- CLI entry points ----
+
+class TestPreemptibleHTTP:
+    """The HTTP face of the preemption drill: 504-with-token, token
+    resume with full error-history parity, token hygiene (400/422),
+    and the tenant label riding serve-side metrics."""
+
+    def _server(self, tmp_path, **kw):
+        kw.setdefault("max_wait", 0.05)
+        kw.setdefault("default_kernel", "roll")
+        kw.setdefault("interpret", True)
+        kw.setdefault("chunk_threshold", 64)
+        kw.setdefault("chunk_steps", 1)
+        kw.setdefault("solve_state_dir", str(tmp_path / "state"))
+        httpd, state = build_server(port=0, **kw)
+        threading.Thread(
+            target=httpd.serve_forever, daemon=True
+        ).start()
+        return httpd, state, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    def test_deadline_504_with_token_then_resume_matches(
+        self, tmp_path
+    ):
+        httpd, state, base = self._server(tmp_path)
+        body = {"N": 8, "timesteps": 193}
+        try:
+            # control march (also warms every chunk program, so the
+            # deadline below expires mid-MARCH, not mid-compile)
+            code, control = _post(base, body)
+            assert code == 200
+            assert control["batch"]["chunked"] is True
+            # a budget far smaller than the march: 504 whose body
+            # carries the resumable state token
+            code, payload = _post(base, dict(body, deadline_ms=20))
+            assert code == 504, payload
+            token = payload.get("resume_token")
+            assert SolveStateStore.valid_token(token), payload
+            # resubmit with the token, no budget: the march finishes
+            # and the FULL per-layer error history matches the
+            # uninterrupted control exactly
+            code, resumed = _post(base, dict(body, resume_token=token))
+            assert code == 200, resumed
+            assert resumed["report"]["final_step"] == 193
+            assert resumed["batch"]["resumed_from"] >= 1
+            assert (resumed["report"]["abs_errors"]
+                    == control["report"]["abs_errors"])
+            assert (resumed["report"]["rel_errors"]
+                    == control["report"]["rel_errors"])
+            _, metrics = _get(base, "/metrics")
+            assert metrics["chunks_total"] > 0
+            assert metrics["preempted_total"] >= 1
+            assert metrics["resumed_total"] >= 1
+        finally:
+            httpd.shutdown()
+            state.batcher.close()
+            httpd.server_close()
+
+    def test_token_hygiene_400_and_422(self, tmp_path):
+        httpd, state, base = self._server(tmp_path)
+        body = {"N": 8, "timesteps": 193}
+        try:
+            # not even token-shaped: rejected at parse (400)
+            code, payload = _post(base, dict(body, resume_token="zz"))
+            assert code == 400
+            # well-formed but never minted: clean 422, never retriable
+            code, payload = _post(
+                base, dict(body, resume_token="0" * 64)
+            )
+            assert code == 422
+            assert "not found" in payload["error"]
+        finally:
+            httpd.shutdown()
+            state.batcher.close()
+            httpd.server_close()
+
+    def test_tenant_header_lands_in_metrics(self, tmp_path):
+        httpd, state, base = self._server(tmp_path)
+        try:
+            code, _, _ = _post_full(
+                base, {"N": 8, "timesteps": 3},
+                headers={"X-Wavetpu-Tenant": "acme"},
+            )
+            assert code == 200
+            req = urllib.request.Request(
+                base + "/metrics", headers={"Accept": "text/plain"}
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                text = r.read().decode()
+            samples, _types = parse_prometheus(text)
+            assert samples[
+                'wavetpu_serve_tenant_requests_total{tenant="acme"}'
+            ] == 1.0
+        finally:
+            httpd.shutdown()
+            state.batcher.close()
+            httpd.server_close()
+
 
 class TestCLI:
     def test_wavetpu_version(self, capsys):

@@ -18,6 +18,12 @@ This client is the matching half:
    the SAME `X-Request-Id`, so `wavetpu trace-report --request ID`
    against the server's telemetry shows the whole retry chain as one
    story, not N unrelated requests.
+ * **Transparent resume**: a 503/504 carrying `resume_token` (a
+   preempted chunked long solve - docs/robustness.md) has the token
+   re-presented on every later attempt, so the retry continues the
+   march from the last completed chunk instead of restarting; a
+   504-with-token is even retried (while budget remains) because each
+   attempt makes forward progress.
 
 `solve()` returns a `SolveOutcome` (it does not raise on HTTP errors -
 the status/error fields are the result; a load generator must count
@@ -299,11 +305,28 @@ class WavetpuClient:
             status, payload, headers, error = self._attempt(
                 send_body, rid, att_timeout
             )
-            if (
-                status == 200
-                or status not in RETRIABLE_STATUSES
-                or attempt > retries
-            ):
+            # Transparent resume (preemptible long solves): a 503 from
+            # a draining replica - or a 504 whose budget died mid-march
+            # - may carry `resume_token`, the server-side checkpoint of
+            # the chunks already marched.  Re-present it on every later
+            # attempt so the retry CONTINUES the solve instead of
+            # restarting at layer 0 (on a fleet, possibly on a
+            # different replica sharing --solve-state-dir).
+            token = (
+                payload.get("resume_token")
+                if isinstance(payload, dict) else None
+            )
+            if isinstance(token, str) and token:
+                body = dict(body, resume_token=token)
+            retriable = status in RETRIABLE_STATUSES or (
+                # 504 is normally final (the budget is gone), but with
+                # a token each retry makes PROGRESS - worth it while
+                # client budget remains.
+                status == 504 and bool(token)
+                and (deadline is None
+                     or deadline - time.monotonic() > 0)
+            )
+            if status == 200 or not retriable or attempt > retries:
                 break
             delay = parse_retry_after(headers)
             if delay is None:

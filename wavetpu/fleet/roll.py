@@ -22,7 +22,14 @@ client-visible error or a single fresh XLA compile:
      (503 + Retry-After absorbed by the router's own member retry),
      snapshots its final counters (frozen into the fleet /metrics
      aggregate - loadgen deltas across the roll stay monotonic), and
-     retires it.
+     retires it.  With `--solve-state-dir` shared across replicas, the
+     drain CHECKPOINTS any in-flight chunked long solve and answers a
+     503 + resume_token; the router re-injects the token on its member
+     retry, so the successor resumes the march from the last completed
+     chunk - the roll hands half-done solves over instead of burning
+     them (docs/robustness.md "Preemptible solves").  The driver reads
+     the router's `resume_handoffs_total` across the cutover and logs
+     how many solves were handed off.
 
 Usage:
 
@@ -92,6 +99,17 @@ def build_manifest(ledger_dir: str, out_path: Optional[str] = None
     with open(out_path, "w", encoding="utf-8") as f:
         json.dump(manifest, f, indent=1, sort_keys=True)
     return out_path
+
+
+def _router_handoffs(router_url: str) -> int:
+    """The router's resume_handoffs_total counter (0 when unreadable -
+    the handoff log line is best-effort, never a roll failure)."""
+    try:
+        snap = _get_json(router_url.rstrip("/") + "/metrics",
+                         timeout=5.0)
+        return int(snap.get("resume_handoffs_total", 0))
+    except (OSError, ValueError, urllib.error.URLError):
+        return 0
 
 
 def wait_ready(base_url: str, timeout_s: float,
@@ -166,6 +184,7 @@ def roll(router_url: str, old_url: str, new_url: str,
                 f"predecessor untouched", file=sys.stderr)
             return 1
         log(f"roll: draining + retiring predecessor {old_url}")
+        handoffs_before = _router_handoffs(router_url)
         _post_json(router_url.rstrip("/") + "/admin/leave",
                    {"url": old_url, "drain": True, "sync": leave_sync})
         if not wait_member_state(router_url, old_url, "left",
@@ -173,6 +192,10 @@ def roll(router_url: str, old_url: str, new_url: str,
             log(f"roll: WARNING - {old_url} did not reach 'left' in "
                 f"{timeout_s:g}s (drain may still be flushing)",
                 file=sys.stderr)
+        handed = _router_handoffs(router_url) - handoffs_before
+        if handed > 0:
+            log(f"roll: {handed} in-flight long solve(s) handed off "
+                f"to the successor via resume tokens")
         log(f"roll: done - {new_url} serving, {old_url} retired")
         return 0
     except (OSError, urllib.error.URLError) as e:

@@ -17,6 +17,20 @@ that proxies /solve across N `wavetpu serve` replicas:
                     503 + Retry-After + retriable (which WavetpuClient
                     absorbs with backoff).  The response carries
                     `X-Wavetpu-Member` naming the replica that served.
+                    `X-Deadline-Ms` is forwarded DECREMENTED by the
+                    router-side wall already burned, and retries stop
+                    when the remaining budget drops below
+                    --min-retry-budget-ms (a doomed retry wastes a
+                    replica slot).  A 503 carrying `resume_token` (a
+                    draining replica checkpointed a chunked long
+                    solve) has the token re-injected into the retried
+                    body, so the next member resumes the march -
+                    cross-replica solve handoff.  With
+                    --api-keys-file, /solve requires a mapped API key
+                    (Authorization: Bearer or X-Api-Key; else 401) and
+                    the router stamps the mapped tenant label as
+                    X-Wavetpu-Tenant, stripping any caller-supplied
+                    value.
   GET /healthz      router liveness + readiness (`ready` = at least
                     one routable member) + per-member state summary.
   GET /metrics      JSON (default): router counters, affinity stats
@@ -64,7 +78,8 @@ from wavetpu.fleet.membership import LEFT, MembershipTable
 _USAGE = (
     "usage: wavetpu router --member URL [--member URL2 ...] "
     "[--host H] [--port P] [--poll-interval-s S] [--fail-threshold K] "
-    "[--proxy-timeout-s S] [--max-body-bytes B]"
+    "[--proxy-timeout-s S] [--max-body-bytes B] "
+    "[--min-retry-budget-ms MS] [--api-keys-file FILE.json]"
 )
 
 # Response headers worth forwarding verbatim from replica to client
@@ -72,10 +87,31 @@ _USAGE = (
 _FORWARD_RESPONSE_HEADERS = (
     "X-Request-Id", "Server-Timing", "Retry-After",
 )
-# Request headers forwarded replica-ward.
+# Request headers forwarded replica-ward.  X-Wavetpu-Tenant passes
+# through only on an UNauthenticated router (trusted internal callers);
+# with --api-keys-file the router strips the inbound value and stamps
+# its own from the key -> tenant map, so the label is unforgeable.
 _FORWARD_REQUEST_HEADERS = (
     "Content-Type", "X-Request-Id", "X-Deadline-Ms",
+    "X-Wavetpu-Tenant",
 )
+
+
+def load_api_keys(path: str) -> Dict[str, str]:
+    """Parse an --api-keys-file: a JSON object {API_KEY: TENANT_LABEL}.
+    Keys terminate AT the router (replicas never see them); the mapped
+    tenant label is what travels on as X-Wavetpu-Tenant."""
+    with open(path, encoding="utf-8") as f:
+        raw = json.load(f)
+    if not isinstance(raw, dict) or not raw or not all(
+        isinstance(k, str) and isinstance(v, str) and k and v
+        for k, v in raw.items()
+    ):
+        raise ValueError(
+            f"{path}: want a non-empty JSON object "
+            f'{{"API_KEY": "tenant-label", ...}}'
+        )
+    return dict(raw)
 
 
 class _ProxyConns:
@@ -144,11 +180,21 @@ class RouterState:
 
     def __init__(self, table: MembershipTable, affinity: AffinityTable,
                  proxy_timeout: float = 120.0,
-                 max_body_bytes: Optional[int] = None):
+                 max_body_bytes: Optional[int] = None,
+                 min_retry_budget_ms: float = 50.0,
+                 api_keys: Optional[Dict[str, str]] = None):
         self.table = table
         self.affinity = affinity
         self.proxy_timeout = proxy_timeout
         self.max_body_bytes = max_body_bytes
+        # Deadline-budget floor for cross-member retries: when the
+        # remaining client budget is below this, a second attempt
+        # cannot finish in time - surface the last answer instead of
+        # burning another replica's queue slot on doomed work.
+        self.min_retry_budget_ms = min_retry_budget_ms
+        # key -> tenant label; None = unauthenticated router (the
+        # historical open mode).
+        self.api_keys = api_keys
         self.conns = _ProxyConns()
         self.started = time.time()
         self._lock = threading.Lock()
@@ -158,7 +204,12 @@ class RouterState:
         self.exhausted_total = 0       # every member refused -> 503
         self.unparseable_total = 0     # body gave no identity (routed
         #                                anyway; the replica 400s it)
+        self.auth_rejected_total = 0   # missing/unknown API key -> 401
+        self.budget_stops_total = 0    # retries refused: budget floor
+        self.resume_handoffs_total = 0  # 503-with-token retried with
+        #                                 the token re-injected
         self.proxied_per_member: Dict[str, int] = {}
+        self.requests_per_tenant: Dict[str, int] = {}
         self._poll_stop = threading.Event()
         self._poller: Optional[threading.Thread] = None
 
@@ -285,6 +336,10 @@ class RouterState:
                 "retries_total": self.retries_total,
                 "exhausted_total": self.exhausted_total,
                 "unparseable_total": self.unparseable_total,
+                "auth_rejected_total": self.auth_rejected_total,
+                "budget_stops_total": self.budget_stops_total,
+                "resume_handoffs_total": self.resume_handoffs_total,
+                "requests_per_tenant": dict(self.requests_per_tenant),
             }
         snap["affinity"] = self.affinity.stats()
         members = self.table.summary()
@@ -306,6 +361,12 @@ class RouterState:
                 snap["retried_requests"],
             "wavetpu_router_retries_total": snap["retries_total"],
             "wavetpu_router_exhausted_total": snap["exhausted_total"],
+            "wavetpu_router_auth_rejected_total":
+                snap["auth_rejected_total"],
+            "wavetpu_router_budget_stops_total":
+                snap["budget_stops_total"],
+            "wavetpu_router_resume_handoffs_total":
+                snap["resume_handoffs_total"],
             'wavetpu_router_affinity_decisions_total{decision="hit"}':
                 aff["hits"],
             'wavetpu_router_affinity_decisions_total{decision="rerouted"}':
@@ -320,6 +381,11 @@ class RouterState:
                 'wavetpu_router_member_proxied_total'
                 f'{{member="{url}"}}'
             ] = row["proxied_total"]
+        for tenant, n in sorted(snap["requests_per_tenant"].items()):
+            own[
+                'wavetpu_router_tenant_requests_total'
+                f'{{tenant="{tenant}"}}'
+            ] = n
         by_state: Dict[str, int] = {}
         for row in snap["members"]:
             by_state[row["state"]] = by_state.get(row["state"], 0) + 1
@@ -472,16 +538,67 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 st.unparseable_total += 1
             return None
 
+    def _auth_tenant(self) -> Tuple[bool, Optional[str]]:
+        """API-key termination: (authorized, tenant_label).  With no
+        --api-keys-file every request is authorized with a pass-through
+        tenant (trusted internal mode); with one, the key must be in
+        the map (Authorization: Bearer K, or X-Api-Key: K) and the
+        MAPPED label replaces whatever tenant header the caller sent -
+        a client can never self-assign a billing identity."""
+        st = self.rstate
+        if st.api_keys is None:
+            return True, self.headers.get("X-Wavetpu-Tenant")
+        key = self.headers.get("X-Api-Key")
+        if not key:
+            auth = self.headers.get("Authorization", "") or ""
+            if auth.startswith("Bearer "):
+                key = auth[len("Bearer "):].strip()
+        tenant = st.api_keys.get(key) if key else None
+        return (tenant is not None), tenant
+
     def _proxy_solve(self, raw: bytes) -> None:
         st = self.rstate
+        t0 = time.monotonic()
         with st._lock:  # noqa: SLF001
             st.requests_total += 1
+        authorized, tenant = self._auth_tenant()
+        if not authorized:
+            with st._lock:  # noqa: SLF001
+                st.auth_rejected_total += 1
+            self._send(401, {
+                "status": "error",
+                "error": "missing or unknown API key",
+            }, {"Connection": "close",
+                "WWW-Authenticate": "Bearer"})
+            return
+        if tenant:
+            with st._lock:  # noqa: SLF001
+                st.requests_per_tenant[tenant] = (
+                    st.requests_per_tenant.get(tenant, 0) + 1
+                )
         ak = self._affinity_key(raw)
         fwd_headers = {
             h: self.headers[h]
             for h in _FORWARD_REQUEST_HEADERS if self.headers.get(h)
         }
         fwd_headers.setdefault("Content-Type", "application/json")
+        if st.api_keys is not None:
+            # The router is the tenant authority: stamp the mapped
+            # label, never the caller's claim.
+            fwd_headers.pop("X-Wavetpu-Tenant", None)
+            if tenant:
+                fwd_headers["X-Wavetpu-Tenant"] = tenant
+        # Client deadline budget (X-Deadline-Ms): each attempt forwards
+        # the REMAINING budget - the original minus router-side
+        # queue/retry wall already burned - so a replica never marches
+        # against wall the client no longer has.
+        budget_ms: Optional[float] = None
+        raw_dl = self.headers.get("X-Deadline-Ms")
+        if raw_dl is not None:
+            try:
+                budget_ms = float(raw_dl)
+            except ValueError:
+                budget_ms = None  # replica owns the 400 contract
         tried = []
         last: Optional[Tuple[int, bytes, Dict[str, str]]] = None
         while True:
@@ -490,6 +607,32 @@ class _RouterHandler(BaseHTTPRequestHandler):
             ]
             if not candidates:
                 break
+            remaining_ms = None
+            if budget_ms is not None:
+                remaining_ms = (
+                    budget_ms - (time.monotonic() - t0) * 1e3
+                )
+                if tried and remaining_ms < st.min_retry_budget_ms:
+                    # A retry below the budget floor cannot finish in
+                    # time: stop here and surface the last answer.
+                    with st._lock:  # noqa: SLF001
+                        st.budget_stops_total += 1
+                    break
+                if remaining_ms <= 0:
+                    # Budget fully burned router-side: answer the 504
+                    # ourselves rather than making a replica say it.
+                    self._send(504, {
+                        "status": "error",
+                        "error": (
+                            f"deadline_ms {budget_ms:g} expired at the "
+                            f"router before any replica could serve"
+                        ),
+                        "deadline_ms": budget_ms,
+                    })
+                    return
+                fwd_headers["X-Deadline-Ms"] = (
+                    f"{max(1.0, remaining_ms):.0f}"
+                )
             if tried:
                 url = self._retry_pick(candidates)
             else:
@@ -524,6 +667,26 @@ class _RouterHandler(BaseHTTPRequestHandler):
             # anything.  Every other status is the request's answer.
             if status not in (0, 503):
                 break
+            if status == 503 and last is not None:
+                # Cross-replica solve handoff: a draining replica's 503
+                # may carry a resume_token (a checkpointed long solve).
+                # Re-inject it into the body so the NEXT member picks
+                # the march up from the last completed chunk instead of
+                # restarting at layer 0.
+                token = None
+                try:
+                    token = json.loads(last[1]).get("resume_token")
+                except (ValueError, AttributeError):
+                    pass
+                if isinstance(token, str) and token:
+                    try:
+                        body_obj = json.loads(raw)
+                        body_obj["resume_token"] = token
+                        raw = json.dumps(body_obj).encode()
+                        with st._lock:  # noqa: SLF001
+                            st.resume_handoffs_total += 1
+                    except (ValueError, TypeError):
+                        pass
         retried = len(tried) > 1
         if last is not None and last[0] not in (0, 503):
             status, body, headers = last
@@ -589,6 +752,8 @@ def build_router(
     fetch=None,
     rng: Optional[random.Random] = None,
     start_poller: bool = True,
+    min_retry_budget_ms: float = 50.0,
+    api_keys: Optional[Dict[str, str]] = None,
 ) -> Tuple[ThreadingHTTPServer, RouterState]:
     """Assemble membership + affinity + HTTP front (port 0 =
     ephemeral).  Does ONE synchronous poll before returning so the
@@ -604,6 +769,7 @@ def build_router(
     state = RouterState(
         table, affinity, proxy_timeout=proxy_timeout,
         max_body_bytes=max_body_bytes,
+        min_retry_budget_ms=min_retry_budget_ms, api_keys=api_keys,
     )
     table.poll_once()
     httpd = ThreadingHTTPServer((host, port), _RouterHandler)
@@ -620,7 +786,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             argv,
             known=("member", "host", "port", "poll-interval-s",
                    "fail-threshold", "proxy-timeout-s",
-                   "max-body-bytes"),
+                   "max-body-bytes", "min-retry-budget-ms",
+                   "api-keys-file"),
             allow_positionals=False,
             repeatable=("member",),
         )
@@ -636,7 +803,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             int(flags["max-body-bytes"])
             if "max-body-bytes" in flags else None
         )
-    except ValueError as e:
+        min_retry_budget_ms = float(
+            flags.get("min-retry-budget-ms", "50")
+        )
+        api_keys = (
+            load_api_keys(flags["api-keys-file"])
+            if "api-keys-file" in flags else None
+        )
+    except (ValueError, OSError) as e:
         print(f"error: {e}", file=sys.stderr)
         print(_USAGE, file=sys.stderr)
         return 2
@@ -644,7 +818,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         members, host=host, port=port,
         poll_interval_s=poll_interval_s, fail_threshold=fail_threshold,
         proxy_timeout=proxy_timeout, max_body_bytes=max_body_bytes,
+        min_retry_budget_ms=min_retry_budget_ms, api_keys=api_keys,
     )
+    if api_keys is not None:
+        print(f"api keys: {len(api_keys)} key(s) -> "
+              f"{len(set(api_keys.values()))} tenant(s)")
     bound = httpd.server_address
     up = len(state.table.routable_urls())
     print(

@@ -70,6 +70,35 @@ from wavetpu.progkey import (  # noqa: E402,F401
 )
 
 
+# ------------------------------------------------- request context
+#
+# Serving-auth round: the router terminates API keys and forwards the
+# mapped tenant label; the scheduler worker binds it here (THREAD-local,
+# not a contextvar - the compile happens on the worker thread, not the
+# HTTP handler thread that knew the tenant) so every ledger line a
+# solve records carries `tenant` without threading it through the whole
+# engine call chain.
+
+_request_ctx = threading.local()
+
+
+def set_request_context(tenant: Optional[str] = None) -> None:
+    """Bind per-request attribution for ledger lines recorded on THIS
+    thread until `clear_request_context`.  None values are dropped."""
+    ctx = {}
+    if tenant:
+        ctx["tenant"] = str(tenant)
+    _request_ctx.fields = ctx
+
+
+def clear_request_context() -> None:
+    _request_ctx.fields = {}
+
+
+def request_context() -> dict:
+    return dict(getattr(_request_ctx, "fields", None) or {})
+
+
 def solo_key(problem, scheme: str, path: str, k: int, dtype: str,
              with_field: bool, compute_errors: bool,
              mesh=None) -> dict:
@@ -131,6 +160,10 @@ class CompileLedger:
                 rec["source"] = str(source)
             if fresh_compile_s is not None:
                 rec["fresh_compile_s"] = round(float(fresh_compile_s), 6)
+            # Serving-auth attribution: whatever request context the
+            # recording thread bound (today: tenant).  Absent outside
+            # the serve path, so CLI ledgers are byte-identical.
+            rec.update(request_context())
             try:
                 if not self._f.closed:
                     self._f.write(json.dumps(rec) + "\n")

@@ -32,7 +32,8 @@ and HTTP layer consult at their seams -
    state is poisoned with NaN AFTER the solve, proving the per-lane
    watchdog 422s it;
  * `serve-slow-batch:seconds=S[,SELECTOR]` - the worker sleeps S before
-   executing a matching batch (deadline/queue-growth drills);
+   executing a matching batch, or before EACH CHUNK of a matching
+   chunked long solve (deadline/queue-growth/preemption drills);
  * `serve-worker-crash[:after=N,count=K]`  - the scheduler worker
    raises mid-batch (its supervisor must restart it and fail in-flight
    futures with retriable 503s, never hang them);
@@ -44,7 +45,17 @@ and HTTP layer consult at their seams -
    rejection branch: a counted miss and a clean recompile;
  * `serve-progcache-fingerprint[:SELECTOR,count=N]` - the expected
    environment fingerprint is poisoned for one load, driving the real
-   cross-version rejection branch the same way.
+   cross-version rejection branch the same way;
+ * `serve-chunk-crash[:SELECTOR,after=K,count=N]` - the scheduler
+   worker dies just before marching a chunk of a matching CHUNKED long
+   solve (`after=K` lets a drill kill it at chunk K precisely); its
+   supervisor restarts the worker and the march resumes from the last
+   completed chunk with zero client-visible errors;
+ * `serve-handoff-corrupt[:SELECTOR,count=N]` - the state-token
+   checkpoint a resume presents is truncated on disk just before the
+   load, driving the content-hash rejection branch: the resume must
+   422 with `InvalidStateTokenError`, never a traceback, and the
+   circuit breaker must never hear it (serve/preempt.py).
 
 SELECTOR is `field=value` pairs matched against the batch's program
 identity (`n`, `timesteps`, `scheme`, `path`, `k`, `dtype`), so one
@@ -204,7 +215,8 @@ def hook_from_env(env: Optional[dict] = None):
 
 SERVE_KINDS = ("compile-fail", "execute-nan", "slow-batch",
                "worker-crash", "conn-drop", "progcache-truncate",
-               "progcache-fingerprint")
+               "progcache-fingerprint", "chunk-crash",
+               "handoff-corrupt")
 
 # Program-identity fields a selector may match on (ctx keys the serve
 # seams pass to `fire`).

@@ -49,6 +49,15 @@ Exit-code contract (wavetpu.cli): 0 complete, 2 usage/load error,
 3 preempted-but-checkpointed (resumable), 4 watchdog halt (last-good
 checkpoint preserved).  See docs/robustness.md.
 
+The SERVE path reuses this module's chunk machinery for preemptible
+long solves: serve/preempt.py's ChunkRunner drives the same
+`make_*chunk_runner` fixed-length chunk programs (compiled once per
+config, ProgramKey `@chunk{L}`) inside the scheduler, with the same
+bitwise-on-the-block-grid guarantee - there the checkpoint is a
+content-addressed state token under --solve-state-dir and "exit 3 /
+requeue me" becomes "504/503 + resume_token / resubmit me"
+(docs/robustness.md "Preemptible solves").
+
 This module stays import-light: jax is imported inside functions so the
 CLI can resolve rotation pointers before the backend exists.
 """

@@ -101,6 +101,8 @@ _USAGE = (
     "[--breaker-threshold K] [--breaker-cooldown-s S] [--no-breaker] "
     "[--warmup N,TIMESTEPS[,K]] [--warmup-manifest MANIFEST.json] "
     "[--program-cache-dir DIR] [--program-cache-max-bytes B] "
+    "[--chunk-threshold T] [--chunk-steps S] "
+    "[--solve-state-dir DIR] [--solve-state-ttl-s S] "
     "[--platform NAME] "
     "[--telemetry-dir DIR] [--record-trace FILE.jsonl] [--version]"
 )
@@ -112,8 +114,9 @@ _KNOWN = (
     "no-errors", "max-amp", "no-watchdog", "no-server-timing",
     "breaker-threshold", "breaker-cooldown-s", "no-breaker",
     "warmup", "warmup-manifest", "program-cache-dir",
-    "program-cache-max-bytes", "platform", "telemetry-dir",
-    "record-trace", "version",
+    "program-cache-max-bytes", "chunk-threshold", "chunk-steps",
+    "solve-state-dir", "solve-state-ttl-s", "platform",
+    "telemetry-dir", "record-trace", "version",
 )
 _VALUELESS = ("no-errors", "no-watchdog", "no-server-timing",
               "no-breaker", "version")
@@ -193,10 +196,21 @@ def parse_solve_request(body: dict, default_kernel: str = "auto"):
         _validate(problem, [lane], ident.path,
                   ident.k if ident.path == "kfused" else 2,
                   compute_errors=False, scheme=ident.scheme)
+    resume_token = body.get("resume_token")
+    if resume_token is not None:
+        # Format-only gate here (400 for plain junk); the state store
+        # re-verifies content hash + identity at load time (422).
+        from wavetpu.serve.preempt import SolveStateStore
+
+        if not isinstance(resume_token, str) or \
+                not SolveStateStore.valid_token(resume_token):
+            raise ValueError(
+                "resume_token must be a 64-char lowercase hex string"
+            )
     return SolveRequest(
         problem=problem, lane=lane, scheme=ident.scheme, path=ident.path,
         k=ident.k, dtype_name=ident.dtype,
-        mesh_shape=mesh,
+        mesh_shape=mesh, resume_token=resume_token,
     )
 
 
@@ -252,6 +266,14 @@ def sanitize_request_id(raw: Optional[str]) -> Optional[str]:
     if not raw or len(raw) > 64 or not set(raw) <= _RID_ALLOWED:
         return None
     return raw
+
+
+def sanitize_tenant(raw: Optional[str]) -> Optional[str]:
+    """The `X-Wavetpu-Tenant` label the router stamped after API-key
+    termination - same token discipline as request ids, so a hostile
+    label can never be reflected into metrics labels, span attrs, or
+    ledger lines."""
+    return sanitize_request_id(raw)
 
 
 def server_timing_header(timing: dict, total_s: float,
@@ -511,6 +533,8 @@ class _Handler(BaseHTTPRequestHandler):
     def _handle_solve(self, rid) -> Tuple[int, dict, dict]:
         from wavetpu.serve.resilience import (
             DeadlineExceededError,
+            InvalidStateTokenError,
+            PreemptedError,
             QuarantinedError,
             WorkerCrashError,
         )
@@ -557,6 +581,11 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             body = json.loads(self.rfile.read(length) or b"{}")
             req = parse_solve_request(body, st.default_kernel)
+            tenant = sanitize_tenant(
+                self.headers.get("X-Wavetpu-Tenant")
+            )
+            if tenant is not None:
+                req = dataclasses.replace(req, tenant=tenant)
             # Deadline contract: `X-Deadline-Ms` header (proxy-settable,
             # wins) or JSON `deadline_ms` - a RELATIVE budget in ms from
             # server receipt.  None (the historical default) disables
@@ -626,7 +655,11 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             lane_result, lane_error, batch_info = fut.result(wait_s)
         except DeadlineExceededError as e:
-            # The scheduler dropped it in queue: 504 with attribution.
+            # The scheduler dropped it (in queue, or mid-march between
+            # chunks): 504 with attribution.  A chunked long solve's
+            # expiry additionally carries `resume_token` - the
+            # checkpointed march, resubmittable with a fresh budget on
+            # any replica sharing --solve-state-dir.
             st.metrics.observe_response(False)
             payload = {
                 "status": "error", "error": str(e),
@@ -634,7 +667,28 @@ class _Handler(BaseHTTPRequestHandler):
             }
             if e.queue_s is not None:
                 payload["queue_ms"] = round(e.queue_s * 1e3, 3)
+            if getattr(e, "resume_token", None) is not None:
+                payload["resume_token"] = e.resume_token
             return 504, payload, {}
+        except PreemptedError as e:
+            # A draining replica checkpointed the march: retriable 503
+            # whose body carries the resume token (the fleet router /
+            # client re-inject it on the retry, which lands on the
+            # rolled successor and continues from the last chunk).
+            st.metrics.observe_response(False)
+            payload = {
+                "status": "error", "error": str(e), "retriable": True,
+            }
+            if e.resume_token is not None:
+                payload["resume_token"] = e.resume_token
+            return 503, payload, {
+                "Retry-After": str(max(1, int(e.retry_after_s + 0.5))),
+            }
+        except InvalidStateTokenError as e:
+            # Client error, never retriable, never a traceback: bad
+            # format, corrupt/expired checkpoint, identity mismatch.
+            st.metrics.observe_response(False)
+            return 422, {"status": "error", "error": str(e)}, {}
         except QuarantinedError as e:
             # Circuit-broken program key: shed with the remaining
             # cooldown as the Retry-After hint.
@@ -726,6 +780,10 @@ def build_server(
     fault_plan=None,
     program_cache_dir: Optional[str] = None,
     program_cache_max_bytes: Optional[int] = None,
+    chunk_threshold: Optional[int] = None,
+    chunk_steps: int = 32,
+    solve_state_dir: Optional[str] = None,
+    solve_state_ttl_s: float = 3600.0,
 ) -> Tuple[ThreadingHTTPServer, ServerState]:
     """Assemble engine + batcher + HTTP server (port 0 = ephemeral; the
     bound port is `httpd.server_address[1]`).  Returned httpd is not yet
@@ -744,7 +802,12 @@ def build_server(
     ONE MetricsRegistry so the Prometheus exposition at /metrics is a
     single consistent cut.  `program_cache_dir` adds the persistent
     disk tier under the engine's program LRU (serve/progcache.py), so
-    compiled programs survive process restarts."""
+    compiled programs survive process restarts.  `chunk_threshold`
+    routes solves with that many timesteps or more through the
+    preemptible chunked march (serve/preempt.py; None = historical
+    monolithic path only); `solve_state_dir` enables mid-flight
+    checkpoints + resume tokens (shared across replicas =
+    cross-replica handoff), GC'd after `solve_state_ttl_s`."""
     from wavetpu.obs.registry import MetricsRegistry
     from wavetpu.run import faults
     from wavetpu.serve.engine import ServeEngine
@@ -763,10 +826,17 @@ def build_server(
         program_cache_max_bytes=program_cache_max_bytes,
     )
     metrics = ServeMetrics(registry=registry)
+    state_store = None
+    if solve_state_dir is not None:
+        from wavetpu.serve.preempt import SolveStateStore
+
+        state_store = SolveStateStore(solve_state_dir,
+                                      ttl_s=solve_state_ttl_s)
     batcher = DynamicBatcher(
         engine, metrics=metrics, max_batch=max_batch, max_wait=max_wait,
         length_bucket_steps=length_bucket_steps, max_queue=max_queue,
-        fault_plan=fault_plan,
+        fault_plan=fault_plan, chunk_threshold=chunk_threshold,
+        chunk_steps=chunk_steps, state_store=state_store,
     )
     recorder = None
     if record_trace is not None:
@@ -851,6 +921,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             int(flags["program-cache-max-bytes"])
             if "program-cache-max-bytes" in flags else None
         )
+        chunk_threshold = (
+            int(flags["chunk-threshold"])
+            if "chunk-threshold" in flags else None
+        )
+        chunk_steps = int(flags.get("chunk-steps", "32"))
+        solve_state_ttl_s = float(flags.get("solve-state-ttl-s", "3600"))
     except (ValueError, OSError) as e:
         print(f"error: {e}", file=sys.stderr)
         print(_USAGE, file=sys.stderr)
@@ -878,6 +954,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         breaker_cooldown_s=breaker_cooldown_s,
         program_cache_dir=flags.get("program-cache-dir"),
         program_cache_max_bytes=program_cache_max_bytes,
+        chunk_threshold=chunk_threshold, chunk_steps=chunk_steps,
+        solve_state_dir=flags.get("solve-state-dir"),
+        solve_state_ttl_s=solve_state_ttl_s,
     )
     if state.engine.progcache is not None:
         pc = state.engine.progcache
@@ -952,7 +1031,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                                     Lz=pk.Lz, T=pk.T,
                                     timesteps=pk.timesteps,
                                 )
-                                if state.engine.program(
+                                if "@chunk" in pk.path:
+                                    # A preemptible chunked-march key
+                                    # (path "roll@chunk64"): warm it
+                                    # through the engine's chunk-runner
+                                    # tier - the vmapped program path
+                                    # would refuse the suffix.
+                                    base, _, clen = pk.path.partition(
+                                        "@chunk"
+                                    )
+                                    state.engine.chunk_runner(
+                                        mp, pk.scheme, base, pk.k,
+                                        pk.dtype, int(clen),
+                                    )
+                                    done += 1
+                                elif state.engine.program(
                                     mp, pk.scheme, pk.path, pk.k,
                                     pk.dtype, pk.with_field, pk.batch,
                                     pk.mesh,

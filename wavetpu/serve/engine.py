@@ -331,12 +331,7 @@ class ServeEngine:
                             else None
                         ),
                     )
-                    with self._lock:
-                        self._programs[key] = prog
-                        self._programs.move_to_end(key)
-                        while len(self._programs) > self.max_programs:
-                            self._programs.popitem(last=False)
-                            self._c_cache.inc(event="eviction")
+                    self._cache_insert(key, prog)
                     return prog, "disk", load_s
         self._c_cache.inc(event="miss")
         # Chaos seam: an injected compile failure lands exactly where a
@@ -412,13 +407,16 @@ class ServeEngine:
                     )
             except Exception:
                 self.progcache.count("store_error")
+        self._cache_insert(key, prog)
+        return prog, "fresh", compile_seconds
+
+    def _cache_insert(self, key: ProgramKey, prog) -> None:
         with self._lock:
             self._programs[key] = prog
             self._programs.move_to_end(key)
             while len(self._programs) > self.max_programs:
                 self._programs.popitem(last=False)
                 self._c_cache.inc(event="eviction")
-        return prog, "fresh", compile_seconds
 
     def warmup(
         self, problem: Problem, scheme: str = "standard",
@@ -437,6 +435,133 @@ class ServeEngine:
             ) is not None:
                 warmed.append(b)
         return warmed
+
+    # ---- chunked long solves (serve/preempt.py) ----
+
+    @staticmethod
+    def chunk_program_key(problem: Problem, scheme: str, path: str,
+                          k: int, dtype_name: str, compute_errors: bool,
+                          chunk_len: int) -> ProgramKey:
+        """The chunk-program identity: the full-march ProgramKey at
+        batch=1 with the chunk geometry folded into the path string
+        (`roll@chunk64`).  `timesteps` stays the TOTAL march length -
+        the chunk program's error oracle and tau depend on it - and the
+        suffix keeps chunked and monolithic executables from colliding
+        in the LRU, the ledger, and the progcache.  Router affinity
+        tables carry the suffixed path transparently (progkey's
+        warm-key plumbing treats path as an opaque string)."""
+        base = ProgramKey.for_batch(
+            problem, scheme, path, k, dtype_name, False,
+            compute_errors, 1, None,
+        )
+        # for_batch normalizes k to 1 off the kfused path, so the
+        # suffix rides in AFTER derivation.
+        return base._replace(path=f"{path}@chunk{chunk_len}")
+
+    def chunk_runner(
+        self, problem: Problem, scheme: str, path: str, k: int,
+        dtype_name: str, chunk_steps: int,
+    ):
+        """The cached ChunkRunner (bootstrap + fixed-length chunk
+        programs) for a long solve's tier - (runner, source,
+        compile_seconds) with the same memory -> disk -> fresh
+        three-tier discipline and attribution as `_program`.  Lives in
+        the same LRU as the ensemble programs (one `max_programs`
+        budget, one hit/miss/eviction account, one warm-keys view).
+        The circuit breaker is NOT consulted here: the chunked path
+        has its own failure handling (per-chunk watchdog 422s, crash
+        re-enqueue, checkpoint-and-preempt), none of which may
+        quarantine the tier."""
+        from wavetpu.run import supervisor
+        from wavetpu.serve import preempt
+
+        fuse = int(k) if path == "kfused" else 1
+        chunk_len = supervisor.chunk_length(int(chunk_steps), fuse)
+        compute_errors = self.compute_errors
+        key = self.chunk_program_key(
+            problem, scheme, path, k, dtype_name, compute_errors,
+            chunk_len,
+        )
+        with self._lock:
+            prog = self._programs.get(key)
+            if prog is not None:
+                self._programs.move_to_end(key)
+                self._c_cache.inc(event="hit")
+                return prog, "memory", 0.0
+
+        def _build():
+            return preempt.ChunkRunner(
+                problem, scheme, path, fuse,
+                self._dtype(dtype_name), dtype_name, compute_errors,
+                chunk_steps=chunk_len, interpret=self.interpret,
+                block_x=self.block_x,
+            )
+
+        key_dict = None
+        if self.progcache is not None and self.progcache.usable:
+            key_dict = compile_ledger.key_from_program_key(key)
+            entry = self.progcache.load(key_dict)
+            if entry is not None:
+                payload, header = entry
+                t0 = time.perf_counter()
+                try:
+                    prog = _build()
+                    prog.adopt_executable(payload)
+                except Exception:
+                    self.progcache.count("corrupt")
+                    prog = None
+                if prog is not None:
+                    load_s = time.perf_counter() - t0
+                    self._c_cache.inc(event="disk_hit")
+                    fresh_s = header.get("compile_s")
+                    if isinstance(fresh_s, (int, float)):
+                        self.progcache.credit_saved(fresh_s, load_s)
+                    compile_ledger.record_compile(
+                        key_dict, load_s, source="disk",
+                        fresh_compile_s=(
+                            fresh_s
+                            if isinstance(fresh_s, (int, float))
+                            else None
+                        ),
+                    )
+                    self._cache_insert(key, prog)
+                    return prog, "disk", load_s
+        self._c_cache.inc(event="miss")
+        # Same chaos seam placement as `_program`: after the miss is
+        # counted, before any build work.
+        if self.fault_plan is not None and self.fault_plan.fire(
+            "compile-fail", n=problem.N, timesteps=problem.timesteps,
+            scheme=scheme, path=path, k=key.k, dtype=dtype_name,
+        ):
+            raise faults.InjectedFault(
+                f"injected compile failure ({scheme}:{path}@chunk"
+                f"{chunk_len} N={problem.N}/{problem.timesteps})"
+            )
+        t0 = time.perf_counter()
+        with tracing.span(
+            "serve.compile", scheme=scheme,
+            path=f"{path}@chunk{chunk_len}", batch=1, n=problem.N,
+            mesh=None,
+        ):
+            prog = _build()
+            prog.prime()
+        compile_seconds = time.perf_counter() - t0
+        self._h_compile.observe(compile_seconds)
+        compile_ledger.record_compile(
+            key_dict if key_dict is not None
+            else compile_ledger.key_from_program_key(key),
+            compile_seconds, source="fresh",
+        )
+        if self.progcache is not None and self.progcache.usable:
+            try:
+                payload = prog.executable_payload()
+                if payload is not None:
+                    self.progcache.put(key_dict, payload,
+                                       compile_seconds)
+            except Exception:
+                self.progcache.count("store_error")
+        self._cache_insert(key, prog)
+        return prog, "fresh", compile_seconds
 
     def breaker_key(self, problem: Problem, scheme: str, path: str,
                     k: int, dtype_name: str, with_field: bool,

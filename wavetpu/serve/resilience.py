@@ -15,6 +15,12 @@ client can tell "retry me" from "your fault" from "too late":
  * `QuarantinedError`       -> HTTP 503 + `Retry-After`.  The request's
    ProgramKey is circuit-broken (K consecutive compile/execute
    failures); `retry_after_s` is the remaining cooldown.
+ * `PreemptedError`         -> HTTP 503 + `Retry-After` + resume_token.
+   A chunked long solve was checkpointed mid-march (drain/roll); the
+   token resumes it on any replica sharing `--solve-state-dir`.
+ * `InvalidStateTokenError` -> HTTP 422.  A `resume_token` failed
+   verification (bad format, missing/corrupt/expired file, or identity
+   mismatch with the request) - the client's fault, never retriable.
 
 `CircuitBreaker` quarantines per program identity (the ProgramKey minus
 its batch bucket - one poisoned tier is ONE breaker however it
@@ -48,15 +54,41 @@ class DeadlineExceededError(RuntimeError):
     scheduler dropped it before batching rather than marching work
     nobody is waiting for."""
 
-    def __init__(self, message: str, queue_s: Optional[float] = None):
+    def __init__(self, message: str, queue_s: Optional[float] = None,
+                 resume_token: Optional[str] = None):
         super().__init__(message)
         self.queue_s = queue_s
+        # Chunked long solves checkpoint on deadline expiry; the 504
+        # carries this token so the client can resume instead of
+        # restarting from layer 0 (serve/preempt.py).
+        self.resume_token = resume_token
 
 
 class WorkerCrashError(RuntimeError):
     """The scheduler worker crashed while this request was in flight.
     The supervisor restarted the worker; the request is RETRIABLE -
     mapped to 503 + Retry-After, never a hang."""
+
+
+class PreemptedError(RuntimeError):
+    """A chunked long solve was checkpointed and preempted before
+    completion (replica drain / rolling deploy).  RETRIABLE: mapped to
+    503 + Retry-After with `resume_token` in the body, so the retry -
+    on this replica or any other sharing `--solve-state-dir` - resumes
+    from the last completed chunk instead of layer 0."""
+
+    def __init__(self, message: str, resume_token: Optional[str] = None,
+                 retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.resume_token = resume_token
+        self.retry_after_s = retry_after_s
+
+
+class InvalidStateTokenError(ValueError):
+    """A `resume_token` failed verification: malformed token, missing or
+    corrupt checkpoint file (content hash mismatch), expired entry, or
+    an identity that does not match the request.  Client error (422),
+    never a traceback and never retriable."""
 
 
 class QuarantinedError(RuntimeError):

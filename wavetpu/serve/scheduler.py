@@ -41,11 +41,17 @@ from typing import List, Optional, Sequence, Tuple
 
 from wavetpu.core.problem import Problem
 from wavetpu.ensemble.batched import LaneSpec
+from wavetpu.obs import ledger as compile_ledger
 from wavetpu.obs import tracing
 from wavetpu.obs.registry import MetricsRegistry
 from wavetpu.obs.report import percentile_nearest_rank
-from wavetpu.run import faults
-from wavetpu.serve.resilience import DeadlineExceededError, WorkerCrashError
+from wavetpu.run import faults, health
+from wavetpu.serve.resilience import (
+    DeadlineExceededError,
+    InvalidStateTokenError,
+    PreemptedError,
+    WorkerCrashError,
+)
 
 
 class QueueFullError(RuntimeError):
@@ -68,6 +74,15 @@ class SolveRequest:
     k: int = 1
     dtype_name: str = "f32"
     mesh_shape: Optional[Tuple[int, int, int]] = None
+    # Preemptible long solves: continue a previously-checkpointed march
+    # (serve/preempt.py state token).  NOT part of bucket_key - a
+    # resumed solve never batches anyway (chunked items get unique
+    # keys).
+    resume_token: Optional[str] = None
+    # Tenant label the router stamped (X-Wavetpu-Tenant); rides into
+    # spans, per-tenant counters, and ledger lines.  Never part of the
+    # program identity.
+    tenant: Optional[str] = None
 
     def bucket_key(self) -> Tuple:
         """Everything the compiled program identity depends on; only
@@ -178,6 +193,29 @@ class ServeMetrics:
             "scheduler-worker crashes absorbed by the supervisor "
             "(in-flight futures failed retriable, worker restarted)",
         )
+        # Preemptible long solves (serve/preempt.py).
+        self._chunks = r.counter(
+            "wavetpu_serve_chunks_total",
+            "chunks marched by preemptible long solves",
+        )
+        self._preempted = r.counter(
+            "wavetpu_serve_preempted_total",
+            "long solves checkpointed/aborted mid-march by reason "
+            "(deadline = 504 + token, drain = retriable 503 + token)",
+            ("reason",),
+        )
+        self._resumes = r.counter(
+            "wavetpu_serve_resumes_total",
+            "long-solve resumptions by source (token = client-supplied "
+            "resume_token, crash = in-memory re-enqueue after a worker "
+            "crash)",
+            ("source",),
+        )
+        self._tenant_requests = r.counter(
+            "wavetpu_serve_tenant_requests_total",
+            "solve requests by router-stamped tenant label",
+            ("tenant",),
+        )
         # Exact-percentile reservoir for the JSON snapshot's historical
         # latency_p50/p95_ms fields (the histogram above serves
         # Prometheus); guarded by the REGISTRY lock so snapshot() is one
@@ -207,6 +245,19 @@ class ServeMetrics:
 
     def observe_worker_restart(self) -> None:
         self._worker_restarts.inc()
+
+    def observe_chunk(self) -> None:
+        self._chunks.inc()
+
+    def observe_preempted(self, reason: str) -> None:
+        self._preempted.inc(reason=reason)
+
+    def observe_resume(self, source: str) -> None:
+        self._resumes.inc(source=source)
+
+    def observe_tenant(self, tenant: Optional[str]) -> None:
+        if tenant:
+            self._tenant_requests.inc(tenant=tenant)
 
     def observe_batch(self, occupancy: int, batched: bool,
                       cells: float, solve_seconds: float,
@@ -310,6 +361,9 @@ class ServeMetrics:
                 "worker_restarts_total": int(
                     self._worker_restarts.value()
                 ),
+                "chunks_total": int(self._chunks.value()),
+                "preempted_total": int(self._preempted.total()),
+                "resumed_total": int(self._resumes.total()),
             }
 
 
@@ -327,6 +381,41 @@ class _Item:
     # an already-expired item at batch formation (HTTP 504) instead of
     # marching work nobody is waiting for.
     deadline: Optional[float] = None
+    # Preemptible long solves: True routes the item through the chunked
+    # march (never batched - its key is unique); `chunk` holds the
+    # march's in-memory progress once the first round initialized it
+    # (worker-crash recovery resumes from it instead of failing the
+    # request).
+    chunked: bool = False
+    chunk: Optional["_ChunkProgress"] = None
+
+
+class _ChunkProgress:
+    """In-memory march state of one chunked long solve between rounds
+    (the item carries it across the scheduler's interleaving and across
+    worker-crash restarts)."""
+
+    __slots__ = (
+        "runner", "state", "step", "abs", "rel", "chunks_done",
+        "wait_s", "compile_s", "execute_s", "warm", "resumed_from",
+    )
+
+    def __init__(self, runner, warm: str, compile_s: float,
+                 wait_s: float):
+        import numpy as np
+
+        self.runner = runner
+        self.state = None
+        self.step = 0
+        t = runner.problem.timesteps
+        self.abs = np.zeros(t + 1, dtype=np.float64)
+        self.rel = np.zeros(t + 1, dtype=np.float64)
+        self.chunks_done = 0
+        self.wait_s = wait_s
+        self.compile_s = compile_s
+        self.execute_s = 0.0
+        self.warm = warm
+        self.resumed_from: Optional[int] = None
 
 
 class DynamicBatcher:
@@ -369,7 +458,10 @@ class DynamicBatcher:
                  max_batch: Optional[int] = None, max_wait: float = 0.025,
                  length_bucket_steps: Optional[int] = None,
                  max_queue: Optional[int] = None,
-                 fault_plan: Optional[faults.ServeFaultPlan] = None):
+                 fault_plan: Optional[faults.ServeFaultPlan] = None,
+                 chunk_threshold: Optional[int] = None,
+                 chunk_steps: int = 32,
+                 state_store=None):
         self.engine = engine
         self.metrics = metrics if metrics is not None else ServeMetrics()
         # Chaos harness: worker-crash / slow-batch injections fire at
@@ -393,8 +485,23 @@ class DynamicBatcher:
             )
         if max_queue is not None and max_queue < 0:
             raise ValueError(f"max_queue must be >= 0, got {max_queue}")
+        if chunk_threshold is not None and chunk_threshold < 2:
+            raise ValueError(
+                f"chunk_threshold must be >= 2, got {chunk_threshold}"
+            )
+        if chunk_steps < 1:
+            raise ValueError(f"chunk_steps must be >= 1, got {chunk_steps}")
         self.max_wait = max_wait
         self.length_bucket_steps = length_bucket_steps
+        # Preemptible long solves: requests with timesteps >= threshold
+        # (None = feature off) march through cached chunk programs
+        # (serve/preempt.py), interleaved with ordinary batches, and
+        # checkpoint to `state_store` (a SolveStateStore; None = no
+        # cross-replica handoff, deadline 504s carry no token).
+        self.chunk_threshold = chunk_threshold
+        self.chunk_steps = chunk_steps
+        self.state_store = state_store
+        self._chunk_seq = 0
         # Bounded-queue backpressure: submit() raises QueueFullError
         # (HTTP 429) once this many requests are submitted-but-not-yet-
         # executing.  None = unbounded (the historical behavior).
@@ -434,6 +541,53 @@ class DynamicBatcher:
     def _item_key(self, request: SolveRequest) -> Tuple:
         return request.bucket_key() + (self.length_bucket(request),)
 
+    def chunk_eligible(self, request: SolveRequest) -> bool:
+        """Whether this request CAN march chunked: the single-backend
+        standard-scheme tiers the supervisor's chunk runners cover, at
+        default phase, full stop, no per-lane field.  Compensated,
+        sharded, shifted-phase, partial-stop, and variable-c requests
+        run monolithic (documented contract, docs/robustness.md)."""
+        from wavetpu.verify import oracle
+
+        r = request
+        return (
+            self.chunk_threshold is not None
+            and hasattr(self.engine, "chunk_runner")
+            and r.mesh_shape is None
+            and r.scheme == "standard"
+            and r.path in ("roll", "pallas", "kfused")
+            and r.lane.c2tau2_field is None
+            and r.lane.phase == oracle.TWO_PI
+            and r.lane.stop(r.problem) == r.problem.timesteps
+            and (r.path != "kfused" or r.problem.N % max(1, r.k) == 0)
+        )
+
+    def _chunk_mode(self, request: SolveRequest) -> bool:
+        """Route through the chunked march?  Long requests past the
+        threshold, plus ANY resume (the token's march is already
+        chunked).  A resume_token on a request that cannot march
+        chunked - or on a replica without the feature - is a client
+        error, rejected synchronously (422)."""
+        eligible = self.chunk_eligible(request)
+        if request.resume_token is not None:
+            if not eligible:
+                raise InvalidStateTokenError(
+                    "resume_token requires a chunk-eligible request "
+                    "(standard scheme, roll/pallas/kfused path, default "
+                    "phase, full stop, no c2_field) on a replica with "
+                    "--chunk-threshold set"
+                )
+            if self.state_store is None:
+                raise InvalidStateTokenError(
+                    "this replica has no --solve-state-dir; it cannot "
+                    "resume a checkpointed solve"
+                )
+            return True
+        return (
+            eligible
+            and request.problem.timesteps >= self.chunk_threshold
+        )
+
     def _dec_depth(self, n: int) -> None:
         # Gauge set INSIDE _plock: a set outside could interleave with a
         # concurrent submit and leave a stale depth on an idle server.
@@ -448,10 +602,20 @@ class DynamicBatcher:
         """`deadline` is an absolute `time.monotonic()` bound (None =
         unbounded, the historical behavior): the worker drops the item
         with `DeadlineExceededError` if it is still queued past it."""
+        chunked = self._chunk_mode(request)
+        if chunked:
+            # A unique key: chunked items never coalesce with (or get
+            # taken as batchmates of) anything - the worker marches them
+            # one chunk per pass, interleaved with ordinary batches.
+            with self._plock:
+                self._chunk_seq += 1
+                key: Tuple = ("__chunk__", self._chunk_seq)
+        else:
+            key = self._item_key(request)
         item = _Item(
-            request, Future(), self._item_key(request),
+            request, Future(), key,
             request_id=request_id, enqueued=time.monotonic(),
-            deadline=deadline,
+            deadline=deadline, chunked=chunked,
         )
         # Closed-check + enqueue are ATOMIC against close() (which
         # flips _closed under this same lock): a submit that passes the
@@ -472,6 +636,7 @@ class DynamicBatcher:
             self.metrics.observe_queue_depth(self._depth)
             self._q.put(item)
         self.metrics.observe_request()
+        self.metrics.observe_tenant(request.tenant)
         return item.future
 
     def close(self, timeout: float = 5.0, drain: bool = False) -> None:
@@ -545,12 +710,30 @@ class DynamicBatcher:
 
     def _crash_cleanup(self, exc: BaseException) -> None:
         items, self._inflight = self._inflight, []
+        requeue: List[_Item] = []
         for item in items:
-            if not item.future.done():
+            if item.future.done():
+                continue
+            if (
+                item.chunk is not None
+                and not (self._closed and not self._drain)
+            ):
+                # A chunked long solve keeps its in-memory march state
+                # on the item: re-enqueue at the FRONT and resume from
+                # the last completed chunk after the worker restart -
+                # the client sees nothing (zero-visible-errors half of
+                # the serve-chunk-crash drill).
+                requeue.append(item)
+            else:
                 item.future.set_exception(WorkerCrashError(
                     f"scheduler worker crashed mid-batch ({exc!r}); "
                     f"worker restarted - retry the request"
                 ))
+        if requeue:
+            with self._plock:
+                self._pending.extendleft(reversed(requeue))
+            for _ in requeue:
+                self.metrics.observe_resume("crash")
         self.metrics.observe_worker_restart()
 
     def _take_pending(self, key, limit: int) -> List[_Item]:
@@ -592,6 +775,22 @@ class DynamicBatcher:
                 if item is None:
                     continue  # sentinel: loop back to the closed check
                 first = item
+            if first.chunked:
+                # One chunk per pass: the march yields the worker back
+                # between chunks so short/high-priority traffic
+                # interleaves instead of queueing behind a monolithic
+                # long solve.
+                self._inflight = [first]
+                finished = self._chunk_round(first)
+                self._inflight = []
+                if not finished:
+                    # Fresh arrivals (still in the queue) go ahead of
+                    # the long solve's next chunk; the item itself goes
+                    # to the back of the stash.
+                    self._drain_queue()
+                    with self._plock:
+                        self._pending.append(first)
+                continue
             batch = [first]
             batch += self._take_pending(
                 first.key, self.max_batch - len(batch)
@@ -678,8 +877,13 @@ class DynamicBatcher:
             occupancy=len(batch), scheme=req0.scheme, path=req0.path,
             k=req0.k, n=req0.problem.N,
             queue_wait_max_ms=round(max(waits) * 1e3, 3),
+            tenant=req0.tenant,
         )
         timing: dict = {}
+        # Tenant attribution is thread-local (the worker thread, not the
+        # handler thread, runs compiles): any ledger line the engine
+        # records during this solve carries the batch leader's tenant.
+        compile_ledger.set_request_context(tenant=req0.tenant)
         try:
             result, lane_health = self.engine.solve(
                 req0.problem,
@@ -694,6 +898,8 @@ class DynamicBatcher:
                 if not item.future.done():
                     item.future.set_exception(e)
             return
+        finally:
+            compile_ledger.clear_request_context()
         t_done = time.monotonic()
         tracing.end_span(
             span, batch_size=result.batch_size, batched=result.batched,
@@ -752,3 +958,271 @@ class DynamicBatcher:
                 item.future.set_result(
                     (result.results[i], lane_health[i], info)
                 )
+
+    # ---- chunked long solves (serve/preempt.py) ----
+
+    def _checkpoint(self, item: _Item) -> Optional[str]:
+        """Persist the item's march state -> resume token, or None when
+        there is nothing to save or no --solve-state-dir.  Guarded: a
+        full disk downgrades the preemption to a token-less abort, it
+        never turns into a 500."""
+        cp = item.chunk
+        if cp is None or cp.state is None or self.state_store is None:
+            return None
+        try:
+            return self.state_store.put(
+                cp.runner.identity,
+                cp.runner.state_to_numpy(cp.state),
+                cp.step, cp.abs, cp.rel,
+            )
+        except Exception:
+            return None
+
+    def _chunk_init(self, item: _Item) -> bool:
+        """First round: queue accounting, chunk-program acquisition,
+        then bootstrap (fresh) or token load (resume).  Returns True
+        when the item is RESOLVED (queue-expired deadline, bad token,
+        or acquisition failure); False to keep marching."""
+        req = item.request
+        now = time.monotonic()
+        wait = max(0.0, now - item.enqueued)
+        self._dec_depth(1)
+        if item.deadline is not None and now >= item.deadline:
+            self.metrics.observe_deadline_expired()
+            if not item.future.done():
+                item.future.set_exception(DeadlineExceededError(
+                    f"deadline expired after {wait * 1e3:.0f} ms in "
+                    f"queue (dropped before execution)",
+                    queue_s=wait,
+                ))
+            return True
+        plan = self.fault_plan
+        compile_ledger.set_request_context(tenant=req.tenant)
+        try:
+            runner, source, acquire_s = self.engine.chunk_runner(
+                req.problem, req.scheme, req.path, req.k,
+                req.dtype_name, self.chunk_steps,
+            )
+            warm_label = (
+                "true" if source == "memory"
+                else "disk" if source == "disk" else "false"
+            )
+            cp = _ChunkProgress(
+                runner, warm=warm_label, compile_s=acquire_s,
+                wait_s=wait,
+            )
+            if req.resume_token is not None:
+                # Chaos seam: serve-handoff-corrupt truncates the
+                # checkpoint file between the client presenting the
+                # token and the replica loading it - the load below
+                # must reject it 422-clean, never traceback (and the
+                # breaker never hears it).
+                if plan is not None and plan.fire(
+                    "handoff-corrupt", n=req.problem.N,
+                    timesteps=req.problem.timesteps, scheme=req.scheme,
+                    path=req.path, k=req.k, dtype=req.dtype_name,
+                ):
+                    target = self.state_store.path_for(
+                        req.resume_token
+                    )
+                    import os as _os
+
+                    if _os.path.exists(target):
+                        faults.truncate_tail(target)
+                _, step, state_np, abs_p, rel_p = (
+                    self.state_store.load(
+                        req.resume_token, cp.runner.identity
+                    )
+                )
+                cp.state = cp.runner.prepare(state_np)
+                cp.step = step
+                cp.abs[: step + 1] = abs_p
+                cp.rel[: step + 1] = rel_p
+                cp.resumed_from = step
+                self.metrics.observe_resume("token")
+            else:
+                state, abs2, rel2, boot_c, boot_s = cp.runner.bootstrap()
+                cp.state = state
+                cp.step = 1
+                cp.abs[:2] = abs2
+                cp.rel[:2] = rel2
+                cp.compile_s += boot_c
+                cp.execute_s += boot_s
+            item.chunk = cp
+            return False
+        except Exception as e:
+            if not item.future.done():
+                item.future.set_exception(e)
+            return True
+        finally:
+            compile_ledger.clear_request_context()
+
+    def _chunk_round(self, item: _Item) -> bool:
+        """March ONE chunk (or initialize on the first round); returns
+        True when the item's future is resolved.  Between rounds the
+        worker serves other traffic - the interleaving that keeps short
+        requests from queueing behind a monolithic long march.
+
+        Preemption points, checked before each chunk:
+          * drain (close(drain=True), the `fleet roll` path):
+            checkpoint -> retriable 503 + resume_token;
+          * deadline expiry: checkpoint -> 504 + resume_token;
+          * per-chunk watchdog AFTER each chunk: a poisoned march 422s
+            at the first chunk boundary past the blowup, with the
+            last-good step attributed - not after marching the
+            remaining thousands of layers.
+        A worker crash leaves the march state on the item;
+        `_crash_cleanup` re-enqueues it and the next round continues
+        from the last completed chunk.  None of these feed the circuit
+        breaker."""
+        if item.future.done():
+            # close() raced and failed it (drain timeout sweep).
+            return True
+        if item.chunk is None:
+            return self._chunk_init(item)
+        req = item.request
+        cp = item.chunk
+        timesteps = req.problem.timesteps
+        if self._closed and self._drain:
+            token = self._checkpoint(item)
+            if token is not None:
+                self.metrics.observe_preempted("drain")
+                item.future.set_exception(PreemptedError(
+                    f"replica draining: long solve checkpointed at "
+                    f"step {cp.step}/{timesteps}; resume with the "
+                    f"token on any replica sharing --solve-state-dir",
+                    resume_token=token,
+                ))
+                return True
+            # No state store: nothing to hand off - finish the march
+            # inside the drain like any other queued work.
+        if item.deadline is not None and time.monotonic() >= item.deadline:
+            token = self._checkpoint(item)
+            self.metrics.observe_deadline_expired()
+            self.metrics.observe_preempted("deadline")
+            item.future.set_exception(DeadlineExceededError(
+                f"deadline expired mid-solve at step "
+                f"{cp.step}/{timesteps}"
+                + ("" if token is None
+                   else "; resume with the returned token"),
+                resume_token=token,
+            ))
+            return True
+        plan = self.fault_plan
+        if plan is not None and plan.active:
+            ctx = dict(
+                n=req.problem.N, timesteps=timesteps,
+                scheme=req.scheme, path=req.path, k=req.k,
+                dtype=req.dtype_name,
+            )
+            if plan.fire("chunk-crash", **ctx):
+                # Models the worker thread dying mid-chunk: escapes to
+                # the supervisor, which re-enqueues this item with its
+                # state intact (see _crash_cleanup) - the client never
+                # sees it.
+                raise faults.InjectedFault(
+                    f"injected worker crash mid-chunk (step {cp.step})"
+                )
+            # slow-batch applies per CHUNK here (the drills' lever for
+            # deterministic mid-march deadline expiry / straddling a
+            # roll cutover).
+            slow = plan.fire("slow-batch", **ctx)
+            if slow is not None:
+                time.sleep(slow.seconds)
+        length = cp.runner.next_length(cp.step)
+        compile_ledger.set_request_context(tenant=req.tenant)
+        try:
+            with tracing.span(
+                "serve.chunk", request_id=item.request_id,
+                tenant=req.tenant, path=req.path, start=cp.step,
+                length=length, n=req.problem.N,
+            ):
+                state, abs_c, rel_c, solve_s, compile_s = (
+                    cp.runner.chunk(cp.state, cp.step, length)
+                )
+        except Exception as e:
+            if not item.future.done():
+                item.future.set_exception(e)
+            return True
+        finally:
+            compile_ledger.clear_request_context()
+        cp.state = state
+        cp.abs[cp.step + 1: cp.step + length + 1] = abs_c
+        cp.rel[cp.step + 1: cp.step + length + 1] = rel_c
+        cp.step += length
+        cp.chunks_done += 1
+        cp.execute_s += solve_s
+        cp.compile_s += compile_s
+        self.metrics.observe_chunk()
+        if self.engine.watchdog:
+            amax = health.state_amax(
+                cp.runner.health_arrays(cp.state)
+            )
+            if not health.healthy(amax, self.engine.max_amp):
+                bound = (
+                    health.DEFAULT_AMP_BOUND
+                    if self.engine.max_amp is None
+                    else self.engine.max_amp
+                )
+                err = (
+                    f"numerical-health trip: guarded amax {amax:g} "
+                    f"exceeds bound {bound:g} (NaN/Inf count as inf) "
+                    f"at step {cp.step} (chunk {cp.chunks_done}); "
+                    f"last good step {cp.step - length}"
+                )
+                item.future.set_result(
+                    (None, err, self._chunk_info(item))
+                )
+                return True
+        if cp.step < timesteps:
+            return False
+        # Complete: the full-march result, bitwise-identical to the
+        # unpreempted monolithic solve (bootstrap-to-1 + block-grid
+        # chunks replay the same op sequence - the supervisor's
+        # invariant).
+        marched = timesteps - (cp.resumed_from or 0)
+        result = cp.runner.to_result(
+            cp.state, cp.abs, cp.rel, timesteps,
+            init_s=cp.compile_s, solve_s=cp.execute_s, marched=marched,
+        )
+        cells = req.problem.cells_per_step * marched
+        self.metrics.observe_batch(
+            occupancy=1, batched=True, cells=cells,
+            solve_seconds=cp.execute_s, batch_size=1,
+            queue_waits=[cp.wait_s],
+            request_ids=[item.request_id],
+        )
+        if not item.future.done():
+            item.future.set_result(
+                (result, None, self._chunk_info(item))
+            )
+        return True
+
+    def _chunk_info(self, item: _Item) -> dict:
+        cp = item.chunk
+        agg = (
+            item.request.problem.cells_per_step
+            * (cp.step - (cp.resumed_from or 0))
+            / cp.execute_s / 1e9
+            if cp.execute_s else 0.0
+        )
+        return {
+            "occupancy": 1,
+            "batch_size": 1,
+            "batched": True,
+            "fallback_reason": None,
+            "path": item.request.path,
+            "padding_lanes": 0,
+            "aggregate_gcells_per_s": round(agg, 4),
+            "warm": cp.warm,
+            "chunked": True,
+            "chunks": cp.chunks_done,
+            "chunk_len": cp.runner.chunk_len,
+            "resumed_from": cp.resumed_from,
+            "timing": {
+                "queue_s": cp.wait_s,
+                "compile_s": cp.compile_s,
+                "execute_s": cp.execute_s,
+                "padding_s": 0.0,
+            },
+        }
