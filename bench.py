@@ -960,6 +960,132 @@ def _fleet_row(interp):
         return {"error": "failed; see stderr"}
 
 
+def _dtrace_row(interp):
+    """Distributed tracing priced end to end: the fleet arm-1 replay
+    (warmed single replica behind a one-member router) with W3C
+    traceparent tracing LIVE ON BOTH TIERS (router --telemetry-dir +
+    replica tracer, loadgen minting trace context per request) vs fully
+    untraced - best-of-2 p95 each side, bar <= 2%.  The row also PROVES
+    the join: the slowest traced request's merged router+replica
+    request view must reconstruct as one tree containing both a
+    router.attempt and a serve.request span."""
+    import os
+    import shutil
+    import tempfile
+    import threading
+    import traceback
+
+    from wavetpu.fleet.router import build_router
+    from wavetpu.loadgen import report as lg_report
+    from wavetpu.loadgen import runner, trace
+    from wavetpu.obs import report as trace_report
+    from wavetpu.obs import tracing
+    from wavetpu.serve.api import build_server
+
+    n, steps, kernel = (8, 6, "roll") if interp else (64, 20, "auto")
+    scenarios = trace.default_scenarios(n=n, timesteps=steps)
+    records = trace.generate(
+        "poisson", duration=3.0, qps=6.0, scenarios=scenarios, seed=29
+    )
+    root = tempfile.mkdtemp(prefix="wavetpu-bench-dtrace-")
+    router_dir = os.path.join(root, "router")
+    replica_dir = os.path.join(root, "replica")
+    try:
+        httpd, state = build_server(
+            port=0, max_wait=0.02, default_kernel=kernel,
+            interpret=interp,
+        )
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+        def front(telemetry_dir=None):
+            rh, rs = build_router(
+                [base], poll_interval_s=0.5, telemetry_dir=telemetry_dir
+            )
+            threading.Thread(target=rh.serve_forever, daemon=True).start()
+            return rh, rs, f"http://127.0.0.1:{rh.server_address[1]}"
+
+        def rep(ru, warmup=0):
+            res = runner.replay(ru, records, mode="closed",
+                                concurrency=4, warmup=warmup,
+                                timeout=1800)
+            return lg_report.build_report(res, target=ru)
+
+        try:
+            rh, rs, ru = front()
+            try:
+                rep(ru, warmup=len(scenarios))  # warm every tier
+                off = min(
+                    rep(ru)["latency_ms"]["p95_ms"] for _ in range(2)
+                )
+            finally:
+                rs.stop_poller()
+                rh.shutdown()
+                rh.server_close()
+            os.makedirs(replica_dir, exist_ok=True)
+            tracing.configure(os.path.join(replica_dir, "trace.jsonl"))
+            rh, rs, ru = front(telemetry_dir=router_dir)
+            try:
+                reports = [rep(ru) for _ in range(2)]
+            finally:
+                rs.stop_poller()
+                rh.shutdown()
+                rh.server_close()
+                if rs.tracer is not None:
+                    rs.tracer.close()
+                tracing.disable()
+        finally:
+            httpd.shutdown()
+            state.batcher.close()
+            httpd.server_close()
+        on = min(r["latency_ms"]["p95_ms"] for r in reports)
+        rep_on = reports[-1]
+        # The join proof: reconstruct the slowest traced request across
+        # both tiers' telemetry dirs.
+        slow = next(
+            (s for s in rep_on["slowest_requests"]
+             if s.get("traceparent")), None
+        )
+        joined_kinds = []
+        if slow is not None:
+            merged = trace_report.load_traces([
+                os.path.join(router_dir, "trace.jsonl"),
+                os.path.join(replica_dir, "trace.jsonl"),
+            ])
+            view = trace_report.request_view(merged, slow["request_id"])
+            joined_kinds = sorted({r["kind"] for r in view})
+        return {
+            "requests": rep_on["requests"],
+            "untraced_p95_ms": off,
+            "traced_p95_ms": on,
+            "dtrace_overhead_p95_pct": round(
+                100.0 * (on - off) / off, 2
+            ) if off else None,
+            "joined_request_id": (
+                None if slow is None else slow["request_id"]
+            ),
+            "joined_span_kinds": joined_kinds,
+            "join_ok": (
+                "router.attempt" in joined_kinds
+                and "serve.request" in joined_kinds
+            ),
+            "policy": "best_of_2",
+            "config": (
+                f"poisson mix {len(records)} reqs, closed loop c=4, "
+                f"N={n}/{steps} kernel={kernel}; warmed "
+                f"router[1 member] replay traced on both tiers vs "
+                f"untraced, bar <= 2% p95; join proof = merged "
+                f"trace-report view of the slowest traced request"
+            ),
+        }
+    except Exception:
+        print("dtrace sub-benchmark failed:", file=sys.stderr)
+        traceback.print_exc()
+        return {"error": "failed; see stderr"}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _occupancy_sweep(interp):
     """Batch-occupancy vs max_wait: the tail-latency/occupancy knob
     measured.  8 requests arrive ~10 ms apart at a max_batch=8 batcher;
@@ -1350,6 +1476,9 @@ def main() -> int:
     # <= 10% p95 bar) and ProgramKey-affinity hit rate + per-replica
     # spread over a two-member fleet.
     subs["fleet"] = _fleet_row(interp)
+    # Distributed tracing: router+replica replay traced on both tiers
+    # vs untraced (<= 2% p95 bar) + the merged cross-process join proof.
+    subs["dtrace"] = _dtrace_row(interp)
     line = {
         "metric": "gcell_updates_per_s",
         "value": head["gcells_per_s"],
@@ -1447,6 +1576,10 @@ def main() -> int:
         "fleet_occupancy_spread": subs["fleet"].get(
             "occupancy_spread"
         ),
+        "dtrace_overhead_p95_pct": subs["dtrace"].get(
+            "dtrace_overhead_p95_pct"
+        ),
+        "dtrace_join_ok": subs["dtrace"].get("join_ok"),
         "headline_summary": True,
     }
     print(json.dumps(summary))

@@ -856,6 +856,126 @@ class TestRouterApiKeys:
             m.close()
 
 
+class TestRouterTracing:
+    """The router's half of the fleet trace contract
+    (docs/observability.md "Distributed tracing"): an untraced router
+    forwards and echoes the inbound traceparent verbatim; a traced one
+    adopts it as the remote parent of `router.request`, re-parents
+    each upstream attempt under a fresh wire id, and marks retries."""
+
+    BODY = {"N": 8, "timesteps": 4}
+
+    def test_untraced_router_forwards_and_echoes_verbatim(self):
+        m = _ScriptedMember()
+        httpd, state, base = _start_router([m.url])
+        tp = "00-" + "ab" * 16 + "-" + "12" * 8 + "-01"
+        try:
+            code, _body, hdrs = _post(
+                base, "/solve", self.BODY,
+                headers={"traceparent": tp},
+            )
+            assert code == 200
+            assert _hget(hdrs, "traceparent") == tp
+            assert _hget(m.seen_headers[0], "traceparent") == tp
+            # no inbound context: nothing invented, nothing echoed
+            code, _body, hdrs = _post(base, "/solve", self.BODY)
+            assert code == 200
+            assert _hget(hdrs, "traceparent") is None
+            assert _hget(m.seen_headers[1], "traceparent") is None
+        finally:
+            httpd.shutdown(); httpd.server_close()
+            state.stop_poller()
+            m.close()
+
+    def test_traced_router_spans_reparent_the_attempt(self, tmp_path):
+        from wavetpu.obs import tracing
+        m = _ScriptedMember()
+        httpd, state, base = _start_router(
+            [m.url], telemetry_dir=str(tmp_path / "rt")
+        )
+        tid, wire = "ab" * 16, "12" * 8
+        try:
+            code, _body, hdrs = _post(
+                base, "/solve", self.BODY,
+                headers={"traceparent": f"00-{tid}-{wire}-01",
+                         "X-Request-Id": "req-tr-1"},
+            )
+            assert code == 200
+            # echo carries the router's OWN context on the same trace
+            echoed = tracing.parse_traceparent(
+                _hget(hdrs, "traceparent")
+            )
+            assert echoed is not None
+            assert echoed[0] == tid and echoed[1] != wire
+            # the member saw the ATTEMPT's wire id, not the client's
+            fwd = tracing.parse_traceparent(
+                _hget(m.seen_headers[0], "traceparent")
+            )
+            assert fwd is not None
+            assert fwd[0] == tid
+            assert fwd[1] not in (wire, echoed[1])
+        finally:
+            httpd.shutdown(); httpd.server_close()
+            state.stop_poller()
+            state.tracer.close()
+            m.close()
+        recs = [
+            json.loads(l)
+            for l in open(str(tmp_path / "rt" / "trace.jsonl"))
+        ]
+        req = [r for r in recs if r["kind"] == "router.request"]
+        att = [r for r in recs if r["kind"] == "router.attempt"]
+        assert len(req) == 1 and len(att) == 1
+        assert req[0]["trace_id"] == tid
+        assert req[0]["parent_id"] == wire        # the client's wire id
+        assert req[0]["attrs"]["w3c_id"] == echoed[1]
+        assert req[0]["attrs"]["request_id"] == "req-tr-1"
+        assert att[0]["trace_id"] == tid
+        assert att[0]["parent_id"] == req[0]["span_id"]
+        assert att[0]["attrs"]["w3c_id"] == fwd[1]
+        assert att[0]["attrs"]["member"] == m.url
+
+    def test_traced_retry_is_marked_and_stays_one_trace(self, tmp_path):
+        # affinity pins the first attempt at holder `a`, whose severed
+        # connection forces the cross-member retry onto `b`
+        kd = progkey.key_from_program_key(
+            progkey.identity_from_body(
+                self.BODY, platform="cpu"
+            ).program_key(4, True)
+        )
+        a = _ScriptedMember(warm_keys={"memory": [kd], "disk": []})
+        b = _ScriptedMember()
+        a.solve_script = ["drop"]
+        httpd, state, base = _start_router(
+            [a.url, b.url], telemetry_dir=str(tmp_path / "rt")
+        )
+        tid = "cd" * 16
+        try:
+            code, _body, _hdrs = _post(
+                base, "/solve", self.BODY,
+                headers={"traceparent": f"00-{tid}-{'34' * 8}-01"},
+            )
+            assert code == 200
+            assert a.solves == 1 and b.solves == 1
+        finally:
+            httpd.shutdown(); httpd.server_close()
+            state.stop_poller()
+            state.tracer.close()
+            a.close(); b.close()
+        recs = [
+            json.loads(l)
+            for l in open(str(tmp_path / "rt" / "trace.jsonl"))
+        ]
+        atts = [r for r in recs if r["kind"] == "router.attempt"]
+        retries = [r for r in recs if r["kind"] == "router.retry"]
+        assert len(atts) == 2 and len(retries) == 1
+        assert all(r["trace_id"] == tid for r in atts + retries)
+        # both attempts carry DISTINCT wire ids under one request span
+        assert (atts[0]["attrs"]["w3c_id"]
+                != atts[1]["attrs"]["w3c_id"])
+        assert atts[0]["parent_id"] == atts[1]["parent_id"]
+
+
 def _start_replica(**kw):
     kw.setdefault("max_wait", 0.02)
     kw.setdefault("default_kernel", "roll")
@@ -1019,14 +1139,31 @@ class TestRollingDeployDrill:
             if h3 is not None:
                 _stop_replica(h3, s3)
 
-    def test_roll_hands_off_inflight_long_solve(self, tmp_path):
+    def test_roll_hands_off_inflight_long_solve(self, tmp_path, capsys):
         """ISSUE tentpole acceptance (drain-roll leg): a chunked long
         solve is IN FLIGHT at the predecessor when `fleet roll` drains
         it.  The drain checkpoints the march (503 + resume_token), the
         router re-injects the token on its member retry, and the
         successor - sharing --solve-state-dir - resumes from the last
         completed chunk.  The zero-retry client sees ONE attempt, a
-        200, and a report exactly equal to an unpreempted run's."""
+        200, and a report exactly equal to an unpreempted run's.
+
+        Tracing leg: router and both replicas write telemetry, and ONE
+        command - `wavetpu trace-report --dir routerT --dir replA
+        --dir replB --request ID` - reconstructs the handed-off solve
+        as a single tree under the client's trace id: router attempts,
+        both replicas' serve.request spans, the drain-handoff mark,
+        and chunk spans from BOTH sides of the preemption."""
+        from wavetpu.cli import main as cli_main
+        from wavetpu.obs import report as trace_report
+        from wavetpu.obs import tracing
+        router_t = str(tmp_path / "routerT")
+        repl_a = str(tmp_path / "replA")
+        repl_b = str(tmp_path / "replB")
+        # the in-process stand-in for per-replica --telemetry-dir: the
+        # module tracer is replica A's until the drain completes, then
+        # replica B's (the router owns its own Tracer either way)
+        tracing.configure(repl_a + "/trace.jsonl")
         state_dir = str(tmp_path / "state")
         body = {"N": 8, "timesteps": 33}
         chunk_kw = dict(chunk_threshold=8, chunk_steps=4,
@@ -1041,6 +1178,7 @@ class TestRollingDeployDrill:
         h1, s1, u1 = _start_replica(fault_plan=plan, **chunk_kw)
         httpd, state, base = _start_router(
             [u1], poll_interval_s=0.3, proxy_timeout=120.0,
+            telemetry_dir=router_t,
         )
         h3 = s3 = None
         u3 = None
@@ -1088,6 +1226,11 @@ class TestRollingDeployDrill:
             while not s1.draining and time.monotonic() < deadline:
                 time.sleep(0.02)
             assert s1.draining
+            # the successor's spans go to its own telemetry dir (in a
+            # real fleet this is B's --telemetry-dir; records still
+            # racing out of A's drain merge fine - the joiner reads
+            # every --dir)
+            tracing.configure(repl_b + "/trace.jsonl")
             s1.batcher.close(timeout=60.0, drain=True)
             rt.join(90.0)
             vt.join(90.0)
@@ -1118,3 +1261,47 @@ class TestRollingDeployDrill:
             _stop_replica(h1, s1)
             if h3 is not None:
                 _stop_replica(h3, s3)
+            if state.tracer is not None:
+                state.tracer.close()
+            tracing.disable()
+        # ---- the one-command joiner over all three telemetry dirs ----
+        rid = out.request_id
+        tid = out.trace_id
+        assert rid and tid
+        paths = [
+            d + "/trace.jsonl" for d in (router_t, repl_a, repl_b)
+        ]
+        # the router handler thread ends its span just AFTER the
+        # response bytes reach the client - give the flush a moment
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            recs = trace_report.load_traces(paths)
+            view = trace_report.request_view(recs, rid)
+            if any(r["kind"] == "router.request" for r in view):
+                break
+            time.sleep(0.05)
+        kinds = {r["kind"] for r in view}
+        assert {"router.request", "router.attempt",
+                "router.drain_handoff", "serve.request",
+                "serve.chunk"} <= kinds, kinds
+        # ONE trace id spans client->router->A->drain->B
+        assert {r.get("trace_id")
+                for r in view if r.get("trace_id")} == {tid}
+        # both replicas answered this request...
+        assert len([r for r in view
+                    if r["kind"] == "serve.request"]) == 2
+        # ...and chunk spans exist on BOTH sides of the preemption
+        # (two distinct tracer namespaces marched chunks)
+        assert len({r["span_id"].split("-")[0] for r in view
+                    if r["kind"] == "serve.chunk"}) == 2
+        # the pinned one-command form: `wavetpu trace-report` over the
+        # three dirs reconstructs and annotates the same tree
+        rc = cli_main([
+            "trace-report", "--dir", router_t, "--dir", repl_a,
+            "--dir", repl_b, "--request", rid,
+        ])
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "joined across 3 processes" in text
+        assert "<-hop" in text
+        assert "router.drain_handoff" in text

@@ -298,6 +298,282 @@ class TestTracing:
         assert rec["attrs"] == {"a": 1, "ok": True}
 
 
+class TestTraceContext:
+    """W3C trace-context plumbing (docs/observability.md "Distributed
+    tracing"): traceparent parse/format, remote-parent adoption, trace
+    id inheritance and stamping, and cross-trace links."""
+
+    def test_mint_and_roundtrip(self):
+        tid, sid = tracing.mint_trace_id(), tracing.mint_span_id()
+        assert len(tid) == 32 and len(sid) == 16
+        int(tid, 16), int(sid, 16)
+        header = tracing.format_traceparent(tid, sid)
+        assert tracing.parse_traceparent(header) == (tid, sid)
+
+    def test_parse_rejects_garbage(self):
+        bad = [
+            None, "", "garbage", "00-abc-def-01",
+            "00-" + "g" * 32 + "-" + "1" * 16 + "-01",   # non-hex
+            "00-" + "0" * 32 + "-" + "1" * 16 + "-01",   # zero trace
+            "00-" + "1" * 32 + "-" + "0" * 16 + "-01",   # zero parent
+            "ff-" + "1" * 32 + "-" + "2" * 16 + "-01",   # reserved ver
+            "00-" + "1" * 31 + "-" + "2" * 16 + "-01",   # short trace
+            "00-" + "1" * 32 + "-" + "2" * 16 + "-01-x",  # 5 fields
+        ]
+        for header in bad:
+            assert tracing.parse_traceparent(header) is None, header
+
+    def test_remote_adoption_and_inheritance(self, tmp_path):
+        """A span opened with remote=(tid, wire_parent) records that
+        exact parentage, and SAME-THREAD children inherit the trace id
+        through the stack."""
+        path = str(tmp_path / "trace.jsonl")
+        tracing.configure(path)
+        tid = tracing.mint_trace_id()
+        wire = tracing.mint_span_id()
+        try:
+            with tracing.span("rx", remote=(tid, wire)):
+                with tracing.span("child"):
+                    tracing.event("tick")
+        finally:
+            tracing.disable()
+        recs = {r["kind"]: r for r in
+                (json.loads(line) for line in open(path))}
+        assert recs["rx"]["parent_id"] == wire
+        assert recs["rx"]["trace_id"] == tid
+        assert recs["child"]["trace_id"] == tid
+        assert recs["child"]["parent_id"] == recs["rx"]["span_id"]
+        assert recs["tick"]["trace_id"] == tid
+
+    def test_trace_id_stamp_without_parenthood(self, tmp_path):
+        """trace_id= alone (the scheduler-thread serve.chunk case)
+        stamps the record but leaves it a tree root."""
+        path = str(tmp_path / "trace.jsonl")
+        tracing.configure(path)
+        tid = tracing.mint_trace_id()
+        try:
+            with tracing.span("chunk", trace_id=tid):
+                pass
+        finally:
+            tracing.disable()
+        (rec,) = [json.loads(line) for line in open(path)]
+        assert rec["trace_id"] == tid and rec["parent_id"] is None
+
+    def test_links_recorded(self, tmp_path):
+        path = str(tmp_path / "trace.jsonl")
+        tracing.configure(path)
+        link = {"trace_id": "ab" * 16, "span_id": "cd" * 8}
+        try:
+            with tracing.span("resumed", links=[link]):
+                pass
+            with tracing.span("plain"):
+                pass
+        finally:
+            tracing.disable()
+        recs = {r["kind"]: r for r in
+                (json.loads(line) for line in open(path))}
+        assert recs["resumed"]["links"] == [link]
+        assert "links" not in recs["plain"]
+
+    def test_untraced_records_carry_no_trace_id(self, tmp_path):
+        path = str(tmp_path / "trace.jsonl")
+        tracing.configure(path)
+        try:
+            with tracing.span("solo"):
+                pass
+        finally:
+            tracing.disable()
+        (rec,) = [json.loads(line) for line in open(path)]
+        assert "trace_id" not in rec
+
+
+class TestTraceJoiner:
+    """The cross-process joiner: wire-id resolution, multi-source
+    merge, link-following trace closure, and the --dir CLI."""
+
+    @staticmethod
+    def _two_tier_trace(tmp_path):
+        """A router + replica trace pair for one request 'r-1', plus an
+        unrelated request on the replica."""
+        tid = tracing.mint_trace_id()
+        att_w3c = tracing.mint_span_id()
+        router = tmp_path / "router"
+        replica = tmp_path / "replica"
+        tr = tracing.Tracer(str(router / "trace.jsonl"))
+        h = tr.begin("router.request",
+                     {"request_id": "r-1", "w3c_id": "aa" * 8},
+                     remote=(tid, None))
+        ha = tr.begin("router.attempt",
+                      {"request_id": "r-1", "w3c_id": att_w3c})
+        tr.end(ha, status=200)
+        tr.end(h, status=200)
+        tr.close()
+        t2 = tracing.Tracer(str(replica / "trace.jsonl"))
+        t2._prefix = "fffe"  # simulate a second process
+        h2 = t2.begin("serve.request",
+                      {"request_id": "r-1", "w3c_id": "bb" * 8},
+                      remote=(tid, att_w3c))
+        t2.end(h2, status=200)
+        h3 = t2.begin("serve.request", {"request_id": "r-2"},
+                      remote=(tracing.mint_trace_id(), None))
+        t2.end(h3, status=200)
+        t2.close()
+        return str(router), str(replica), tid
+
+    def test_join_resolves_wire_parent(self, tmp_path):
+        router, replica, tid = self._two_tier_trace(tmp_path)
+        records = obs_report.load_traces([
+            os.path.join(router, "trace.jsonl"),
+            os.path.join(replica, "trace.jsonl"),
+        ])
+        joined = obs_report.join_processes(records)
+        by_kind = {r["kind"]: r for r in joined
+                   if r["attrs"].get("request_id") == "r-1"}
+        assert (by_kind["serve.request"]["parent_id"]
+                == by_kind["router.attempt"]["span_id"])
+        view = obs_report.request_view(records, "r-1")
+        kinds = [r["kind"] for r in view]
+        assert kinds == ["router.request", "router.attempt",
+                         "serve.request"]
+        assert {r["trace_id"] for r in view} == {tid}
+        text = obs_report.format_request_view(view, "r-1")
+        assert "joined across 2 processes" in text
+        assert "<-hop" in text
+
+    def test_unresolvable_wire_parent_roots_cleanly(self, tmp_path):
+        """A replica-only view (upstream dir not passed) must render the
+        serve.request as a root, not dangle under an unknown parent."""
+        _, replica, _ = self._two_tier_trace(tmp_path)
+        records = obs_report.load_trace(
+            os.path.join(replica, "trace.jsonl"))
+        view = obs_report.request_view(records, "r-1")
+        assert [r["kind"] for r in view] == ["serve.request"]
+        assert view[0]["parent_id"] is None
+
+    def test_link_closure_joins_resume_chain_both_ways(self, tmp_path):
+        """A march resumed under a FRESH trace links back to the
+        originating request; querying by EITHER request id must pull in
+        the whole chain."""
+        t = tracing.Tracer(str(tmp_path / "trace.jsonl"))
+        tid1, tid2 = tracing.mint_trace_id(), tracing.mint_trace_id()
+        h = t.begin("serve.request", {"request_id": "orig"},
+                    remote=(tid1, None))
+        origin = [tid1, "ee" * 8]
+        t.end(h, status=504)
+        h2 = t.begin("serve.request", {"request_id": "resumed"},
+                     remote=(tid2, None))
+        t.end(h2, status=200)
+        hc = t.begin(
+            "serve.chunk", {"request_id": "resumed"}, trace_id=tid2,
+            links=[{"trace_id": origin[0], "span_id": origin[1]}],
+        )
+        t.end(hc)
+        t.close()
+        records = obs_report.load_trace(str(tmp_path / "trace.jsonl"))
+        for rid in ("orig", "resumed"):
+            view = obs_report.request_view(records, rid)
+            kinds = sorted(r["kind"] for r in view)
+            assert kinds == ["serve.chunk", "serve.request",
+                             "serve.request"], (rid, kinds)
+        text = obs_report.format_request_view(
+            obs_report.request_view(records, "orig"), "orig")
+        assert "~>resumed-from" in text
+
+    def test_cli_multi_dir(self, tmp_path, capsys):
+        from wavetpu.cli import main
+
+        router, replica, _ = self._two_tier_trace(tmp_path)
+        assert main(["trace-report", "--dir", router, "--dir", replica,
+                     "--request", "r-1"]) == 0
+        out = capsys.readouterr().out
+        assert "router.attempt" in out and "serve.request" in out
+        # summary mode merges too
+        assert main(["trace-report", "--dir", router,
+                     "--dir", replica]) == 0
+        out = capsys.readouterr().out
+        assert "router.request" in out and "serve.request" in out
+        # no sources is a usage error
+        assert main(["trace-report"]) == 2
+
+    def test_multi_source_merge_includes_rotated(self, tmp_path):
+        """--dir merges each source's rotated segment set oldest-first
+        (the long-lived-server case)."""
+        a = tmp_path / "a"
+        tracing.configure(str(a / "trace.jsonl"), max_bytes=300, keep=3)
+        try:
+            for i in range(12):
+                tracing.event("rot.tick", n=i)
+        finally:
+            tracing.disable()
+        b = tmp_path / "b"
+        tracing.configure(str(b / "trace.jsonl"))
+        try:
+            tracing.event("other.tick", n=99)
+        finally:
+            tracing.disable()
+        records = obs_report.load_traces([
+            str(a / "trace.jsonl"), str(b / "trace.jsonl"),
+        ])
+        kinds = {r["kind"] for r in records}
+        assert kinds == {"rot.tick", "other.tick"}
+        ns = [r["attrs"]["n"] for r in records
+              if r["kind"] == "rot.tick"]
+        assert ns == sorted(ns) and ns[-1] == 11 and len(ns) > 1
+
+
+class TestMetricCatalogLint:
+    """Every wavetpu_* metric the code constructs must be documented in
+    docs/observability.md's metric catalog - an undocumented metric is
+    a tier-1 failure, not a drive-by (ISSUE: the catalog is the
+    contract operators alert on)."""
+
+    @staticmethod
+    def _constructed_metrics():
+        import re
+
+        root = os.path.join(os.path.dirname(__file__), "..", "wavetpu")
+        ctor = re.compile(
+            r"(?:counter|gauge|histogram)\(\s*['\"]"
+            r"(wavetpu_[a-z0-9_]+)['\"]"
+        )
+        # The router renders its own samples as text, not through the
+        # registry - catch every full-name literal there too.
+        router_lit = re.compile(r"['\"](wavetpu_router_[a-z0-9_]+)")
+        names = set()
+        for dirpath, _dirs, files in os.walk(root):
+            if "__pycache__" in dirpath:
+                continue
+            for fn in files:
+                if not fn.endswith(".py"):
+                    continue
+                src = open(os.path.join(dirpath, fn),
+                           encoding="utf-8").read()
+                names.update(ctor.findall(src))
+                if fn == "router.py":
+                    names.update(
+                        m for m in router_lit.findall(src)
+                        if not m.endswith("_")
+                    )
+        return names
+
+    def test_every_constructed_metric_is_documented(self):
+        import re
+
+        doc = open(
+            os.path.join(os.path.dirname(__file__), "..", "docs",
+                         "observability.md"),
+            encoding="utf-8",
+        ).read()
+        documented = set(re.findall(r"wavetpu_[a-z0-9_]+", doc))
+        constructed = self._constructed_metrics()
+        assert constructed, "lint found no metrics - pattern broke?"
+        missing = sorted(constructed - documented)
+        assert not missing, (
+            f"metrics constructed in wavetpu/ but absent from "
+            f"docs/observability.md's catalog: {missing}"
+        )
+
+
 class TestTraceRotation:
     """Size-based telemetry rotation: a long-lived server must not grow
     trace.jsonl / heartbeat.jsonl forever (keep-last-K segments, atomic

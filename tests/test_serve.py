@@ -1560,6 +1560,173 @@ class TestPreemptibleHTTP:
             httpd.server_close()
 
 
+class TestDistributedTracingServe:
+    """The replica's half of the fleet trace contract
+    (docs/observability.md "Distributed tracing"): traceparent echoed
+    on every /solve answer, inbound context adopted as the remote
+    parent of serve.request, the in-flight chunk-march gauge, and the
+    originating trace context riding the resume checkpoint so a
+    preempted march resumed under a NEW trace links back to its first
+    request."""
+
+    @staticmethod
+    def _lower(headers):
+        return {k.lower(): v for k, v in headers.items()}
+
+    def test_untraced_replica_reflects_inbound_verbatim(self, server):
+        base, _state = server
+        tp = "00-" + "ab" * 16 + "-" + "12" * 8 + "-01"
+        code, _body, hdrs = _post_full(
+            base, {"N": 8, "timesteps": 3}, headers={"traceparent": tp}
+        )
+        assert code == 200
+        # untraced tier: the join handle still answers - the inbound
+        # header comes back untouched
+        assert self._lower(hdrs).get("traceparent") == tp
+
+    def test_untraced_replica_without_inbound_sends_no_header(
+        self, server
+    ):
+        base, _state = server
+        code, _body, hdrs = _post_full(base, {"N": 8, "timesteps": 3})
+        assert code == 200
+        assert "traceparent" not in self._lower(hdrs)
+
+    def test_untraced_replica_drops_malformed_inbound(self, server):
+        base, _state = server
+        code, _body, hdrs = _post_full(
+            base, {"N": 8, "timesteps": 3},
+            headers={"traceparent": "00-nothex-11-01"},
+        )
+        assert code == 200
+        assert "traceparent" not in self._lower(hdrs)
+
+    def test_traced_replica_adopts_inbound_and_echoes_own_context(
+        self, tmp_path
+    ):
+        from wavetpu.obs import tracing
+        trace_path = str(tmp_path / "trace.jsonl")
+        tracing.configure(trace_path)
+        httpd, state = build_server(
+            port=0, max_wait=0.05, default_kernel="roll", interpret=True
+        )
+        threading.Thread(
+            target=httpd.serve_forever, daemon=True
+        ).start()
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        tid, wire = "ab" * 16, "12" * 8
+        try:
+            code, _body, hdrs = _post_full(
+                base, {"N": 8, "timesteps": 3},
+                headers={"traceparent": f"00-{tid}-{wire}-01"},
+            )
+            assert code == 200
+            echoed = tracing.parse_traceparent(
+                self._lower(hdrs)["traceparent"]
+            )
+            # traced tier overwrites the echo with its OWN context:
+            # same fleet trace id, fresh wire span id
+            assert echoed is not None
+            assert echoed[0] == tid
+            assert echoed[1] != wire
+            # no inbound context: a fresh trace id is minted
+            code, _body, hdrs2 = _post_full(
+                base, {"N": 8, "timesteps": 3}
+            )
+            assert code == 200
+            fresh = tracing.parse_traceparent(
+                self._lower(hdrs2)["traceparent"]
+            )
+            assert fresh is not None and fresh[0] != tid
+        finally:
+            httpd.shutdown()
+            state.batcher.close()
+            httpd.server_close()
+            tracing.disable()
+        recs = [json.loads(l) for l in open(trace_path)]
+        adopted = [
+            r for r in recs
+            if r.get("kind") == "serve.request"
+            and r.get("trace_id") == tid
+        ]
+        assert len(adopted) == 1
+        # the inbound wire id IS the remote parent, and the span
+        # advertises the echoed wire id for the cross-process joiner
+        assert adopted[0]["parent_id"] == wire
+        assert adopted[0]["attrs"]["w3c_id"] == echoed[1]
+
+    def test_inflight_gauge_and_origin_trace_ride_checkpoint(
+        self, tmp_path
+    ):
+        from wavetpu.obs import tracing
+        eng = ServeEngine(bucket_sizes=(1,), interpret=True)
+        p = Problem(N=8, timesteps=17)
+        store_dir = str(tmp_path / "state")
+        plan = faults.parse_serve_spec(
+            f"serve-slow-batch:seconds=0.25,timesteps={p.timesteps}"
+        )
+        origin = ("ab" * 16, "cd" * 8)
+        b = DynamicBatcher(
+            eng, max_wait=0.02, fault_plan=plan, chunk_threshold=8,
+            chunk_steps=4, state_store=SolveStateStore(store_dir),
+        )
+        gauge = b.metrics._inflight_chunks
+        try:
+            fut = b.submit(
+                _req(p), deadline=time.monotonic() + 0.4,
+                trace_context=origin,
+            )
+            # the gauge rises while the march is genuinely in flight...
+            seen, deadline = 0.0, time.monotonic() + 60.0
+            while time.monotonic() < deadline and not fut.done():
+                seen = max(seen, gauge.value())
+                time.sleep(0.005)
+            with pytest.raises(DeadlineExceededError) as ei:
+                fut.result(120)
+            token = ei.value.resume_token
+        finally:
+            b.close()
+        assert seen == 1.0
+        # ...and falls back to zero however the march ends (here:
+        # deadline preemption)
+        assert gauge.value() == 0.0
+        # resume on a traced successor under a DIFFERENT client trace:
+        # the checkpoint's origin_trace turns into span links, so the
+        # whole march is still one joinable story
+        trace_path = str(tmp_path / "trace.jsonl")
+        tracing.configure(trace_path)
+        b2 = DynamicBatcher(
+            eng, max_wait=0.02, chunk_threshold=8, chunk_steps=4,
+            state_store=SolveStateStore(store_dir),
+        )
+        fresh = ("12" * 16, "34" * 8)
+        try:
+            req = SolveRequest(
+                problem=p, lane=eb.LaneSpec(), resume_token=token
+            )
+            res, health, info = b2.submit(
+                req, trace_context=fresh
+            ).result(120)
+            assert health is None
+            assert info["resumed_from"] >= 1
+        finally:
+            b2.close()
+            tracing.disable()
+        end = time.monotonic() + 5.0
+        while (b2.metrics._inflight_chunks.value() != 0.0
+               and time.monotonic() < end):
+            time.sleep(0.005)
+        assert b2.metrics._inflight_chunks.value() == 0.0
+        recs = [json.loads(l) for l in open(trace_path)]
+        chunks = [r for r in recs if r.get("kind") == "serve.chunk"]
+        assert chunks
+        for r in chunks:
+            assert r.get("trace_id") == fresh[0]
+            assert r.get("links") == [
+                {"trace_id": origin[0], "span_id": origin[1]}
+            ]
+
+
 class TestCLI:
     def test_wavetpu_version(self, capsys):
         from wavetpu import __version__

@@ -167,9 +167,13 @@ deadlines, Retry-After, circuit breaker, worker supervision, chaos
 injection via WAVETPU_FAULT serve-* specs - in docs/robustness.md,
 with `wavetpu.client.WavetpuClient` as the retrying client half).
 `wavetpu trace-report
-TRACE.jsonl [--kind K] [--request ID]` summarizes a --telemetry-dir
-span trace (per-kind count/total/p50/p95; critical-path view of one
-request - wavetpu/obs/report.py; rotated segment sets are read whole).
+[TRACE.jsonl ...] [--dir DIR ...] [--kind K] [--request ID]` summarizes
+--telemetry-dir span traces (per-kind count/total/p50/p95; critical-path
+view of one request - wavetpu/obs/report.py; rotated segment sets are
+read whole); with several sources (router + replicas) it joins W3C
+traceparent-linked spans into ONE cross-process tree, including solves
+preempted on one replica and resumed on another (docs/observability.md
+"Distributed tracing").
 `wavetpu ledger-report TELEMETRY_DIR [--json]
 [--emit-warmup-manifest OUT.json]` aggregates the compile-cost ledger
 (wavetpu/obs/ledger.py): per-ProgramKey compile spend, keys recompiled
@@ -402,7 +406,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(f"error: {e}", file=sys.stderr)
         print(
             "usage: wavetpu N Np Lx Ly Lz [T] [timesteps] | "
-            "wavetpu serve [...] | wavetpu trace-report TRACE.jsonl | "
+            "wavetpu serve [...] | "
+            "wavetpu trace-report [TRACE.jsonl ...] [--dir DIR ...] | "
             "wavetpu loadgen generate|replay|gate [...] | "
             "wavetpu ledger-report DIR [...] | "
             "wavetpu profile --out DIR ARGS... | "

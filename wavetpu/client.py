@@ -18,6 +18,12 @@ This client is the matching half:
    the SAME `X-Request-Id`, so `wavetpu trace-report --request ID`
    against the server's telemetry shows the whole retry chain as one
    story, not N unrelated requests.
+ * **Distributed trace context**: every attempt also carries the SAME
+   W3C `traceparent` (one trace id minted per logical request), so the
+   router's and every replica's spans for all attempts hang under ONE
+   fleet-wide trace (docs/observability.md "Distributed tracing").  The
+   server echoes the trace context back; `SolveOutcome.traceparent` is
+   the join handle `wavetpu trace-report` resolves.
  * **Transparent resume**: a 503/504 carrying `resume_token` (a
    preempted chunked long solve - docs/robustness.md) has the token
    re-presented on every later attempt, so the retry continues the
@@ -53,6 +59,9 @@ import time
 import urllib.parse
 from typing import Callable, Dict, List, Optional, Tuple
 
+from wavetpu.obs.tracing import format_traceparent, mint_span_id, \
+    mint_trace_id
+
 # Outcomes worth a retry: transport failure (status 0), backpressure
 # (429), engine failure (500 - the batch died, a retry lands in a fresh
 # batch), and retriable unavailability (503: draining, quarantined
@@ -73,6 +82,7 @@ class SolveOutcome:
     latency_s: float               # wall across ALL attempts + backoff
     request_id: str                # the id EVERY attempt carried
     error: Optional[str] = None    # final error string (None on 200)
+    traceparent: str = ""          # W3C context EVERY attempt carried
 
     @property
     def ok(self) -> bool:
@@ -81,6 +91,13 @@ class SolveOutcome:
     @property
     def server_timing(self) -> Optional[str]:
         return self.headers.get("Server-Timing")
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        """The 32-hex fleet trace id this request rode (None if the
+        client somehow sent no context)."""
+        parts = self.traceparent.split("-")
+        return parts[1] if len(parts) == 4 else None
 
 
 def parse_retry_after(headers: Dict[str, str]) -> Optional[float]:
@@ -228,15 +245,18 @@ class WavetpuClient:
             self._reset_conn(orderly=True)
         return resp.status, raw, dict(resp.headers)
 
-    def _attempt(self, body: dict, rid: str, timeout: float):
+    def _attempt(self, body: dict, rid: str, timeout: float,
+                 traceparent: str = ""):
         """One POST /solve: (status, payload, headers, error)."""
+        headers = {
+            "Content-Type": "application/json",
+            "X-Request-Id": rid,
+        }
+        if traceparent:
+            headers["traceparent"] = traceparent
         try:
             status, raw, headers = self._request(
-                "POST", "/solve", json.dumps(body).encode(),
-                {
-                    "Content-Type": "application/json",
-                    "X-Request-Id": rid,
-                },
+                "POST", "/solve", json.dumps(body).encode(), headers,
                 timeout,
             )
         except (OSError, http.client.HTTPException) as e:
@@ -275,6 +295,10 @@ class WavetpuClient:
         )
         timeout = self.timeout if timeout is None else timeout
         rid = request_id or self._mint()
+        # One trace id for the whole logical request: every attempt
+        # (and thus every router hop and replica it lands on) carries
+        # the SAME traceparent, so retries are one fleet trace.
+        traceparent = format_traceparent(mint_trace_id(), mint_span_id())
         t0 = time.monotonic()
         deadline = None if deadline_s is None else t0 + deadline_s
         retried: List[dict] = []
@@ -303,7 +327,7 @@ class WavetpuClient:
             )
             attempt += 1
             status, payload, headers, error = self._attempt(
-                send_body, rid, att_timeout
+                send_body, rid, att_timeout, traceparent
             )
             # Transparent resume (preemptible long solves): a 503 from
             # a draining replica - or a 504 whose budget died mid-march
@@ -354,4 +378,5 @@ class WavetpuClient:
             attempts=attempt, retries=retried,
             latency_s=time.monotonic() - t0, request_id=rid,
             error=error if status != 200 else None,
+            traceparent=traceparent,
         )

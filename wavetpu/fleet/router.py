@@ -31,6 +31,18 @@ that proxies /solve across N `wavetpu serve` replicas:
                     the router stamps the mapped tenant label as
                     X-Wavetpu-Tenant, stripping any caller-supplied
                     value.
+                    With --telemetry-dir the router writes its OWN
+                    trace.jsonl (obs/tracing.py records): a
+                    `router.request` span per proxied /solve with
+                    `router.attempt` children per member try plus
+                    `router.retry` / `router.drain_handoff` events -
+                    adopting the client's W3C `traceparent` as remote
+                    parent and minting a fresh per-attempt context for
+                    the replica, so `wavetpu trace-report --dir ...`
+                    joins router and replica spans into ONE fleet
+                    trace (docs/observability.md "Distributed
+                    tracing").  The trace context is echoed on every
+                    /solve response.
   GET /healthz      router liveness + readiness (`ready` = at least
                     one routable member) + per-member state summary.
   GET /metrics      JSON (default): router counters, affinity stats
@@ -59,6 +71,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import os
 import random
 import sys
 import threading
@@ -74,27 +87,59 @@ from wavetpu.fleet.affinity import (
     warm_label_from_server_timing,
 )
 from wavetpu.fleet.membership import LEFT, MembershipTable
+from wavetpu.obs import tracing
+from wavetpu.obs.telemetry import (
+    DEFAULT_MAX_BYTES,
+    ROTATE_KEEP,
+    TRACE_FILENAME,
+)
 
 _USAGE = (
     "usage: wavetpu router --member URL [--member URL2 ...] "
     "[--host H] [--port P] [--poll-interval-s S] [--fail-threshold K] "
     "[--proxy-timeout-s S] [--max-body-bytes B] "
-    "[--min-retry-budget-ms MS] [--api-keys-file FILE.json]"
+    "[--min-retry-budget-ms MS] [--api-keys-file FILE.json] "
+    "[--telemetry-dir DIR]"
 )
 
 # Response headers worth forwarding verbatim from replica to client
 # (the rest are hop-by-hop or recomputed by the router's send path).
+# `traceparent` is the replica's trace-context echo; a TRACED router
+# overwrites it with its own outer-hop context before answering.
 _FORWARD_RESPONSE_HEADERS = (
-    "X-Request-Id", "Server-Timing", "Retry-After",
+    "X-Request-Id", "Server-Timing", "Retry-After", "traceparent",
 )
 # Request headers forwarded replica-ward.  X-Wavetpu-Tenant passes
 # through only on an UNauthenticated router (trusted internal callers);
 # with --api-keys-file the router strips the inbound value and stamps
 # its own from the key -> tenant map, so the label is unforgeable.
+# `traceparent` passes through verbatim on an UNtraced router (the
+# client's context still reaches the replica); a traced router replaces
+# it with a fresh per-attempt context under the same trace id.
 _FORWARD_REQUEST_HEADERS = (
     "Content-Type", "X-Request-Id", "X-Deadline-Ms",
-    "X-Wavetpu-Tenant",
+    "X-Wavetpu-Tenant", "traceparent",
 )
+
+
+def _server_timing_total_ms(header: Optional[str]) -> Optional[float]:
+    """The `total;dur=` milliseconds from a replica's Server-Timing
+    header - the replica-side wall for the per-hop attribution counters
+    (router wall vs replica wall).  None when absent/unparseable."""
+    if not header:
+        return None
+    for part in header.split(","):
+        name, _, params = part.strip().partition(";")
+        if name.strip() != "total":
+            continue
+        for p in params.split(";"):
+            k, _, v = p.strip().partition("=")
+            if k == "dur":
+                try:
+                    return float(v)
+                except ValueError:
+                    return None
+    return None
 
 
 def load_api_keys(path: str) -> Dict[str, str]:
@@ -208,6 +253,17 @@ class RouterState:
         self.budget_stops_total = 0    # retries refused: budget floor
         self.resume_handoffs_total = 0  # 503-with-token retried with
         #                                 the token re-injected
+        # Per-hop wall attribution: cumulative router-side wall per
+        # proxied /solve vs the replica-side wall the members reported
+        # (Server-Timing `total;dur=`).  The difference is the
+        # network/queue/retry overhead the router tier added.
+        self.proxy_wall_ms_total = 0.0
+        self.upstream_wall_ms_total = 0.0
+        # The router's OWN Tracer (--telemetry-dir), deliberately NOT
+        # the module-level singleton: a test process may host this
+        # router and N in-process replicas, each with its own trace
+        # file - the router must not clobber theirs (or vice versa).
+        self.tracer: Optional[tracing.Tracer] = None
         self.proxied_per_member: Dict[str, int] = {}
         self.requests_per_tenant: Dict[str, int] = {}
         self._poll_stop = threading.Event()
@@ -339,6 +395,12 @@ class RouterState:
                 "auth_rejected_total": self.auth_rejected_total,
                 "budget_stops_total": self.budget_stops_total,
                 "resume_handoffs_total": self.resume_handoffs_total,
+                "proxy_wall_ms_total": round(
+                    self.proxy_wall_ms_total, 3
+                ),
+                "upstream_wall_ms_total": round(
+                    self.upstream_wall_ms_total, 3
+                ),
                 "requests_per_tenant": dict(self.requests_per_tenant),
             }
         snap["affinity"] = self.affinity.stats()
@@ -367,6 +429,10 @@ class RouterState:
                 snap["budget_stops_total"],
             "wavetpu_router_resume_handoffs_total":
                 snap["resume_handoffs_total"],
+            "wavetpu_router_proxy_wall_ms_total":
+                snap["proxy_wall_ms_total"],
+            "wavetpu_router_upstream_wall_ms_total":
+                snap["upstream_wall_ms_total"],
             'wavetpu_router_affinity_decisions_total{decision="hit"}':
                 aff["hits"],
             'wavetpu_router_affinity_decisions_total{decision="rerouted"}':
@@ -556,6 +622,16 @@ class _RouterHandler(BaseHTTPRequestHandler):
         tenant = st.api_keys.get(key) if key else None
         return (tenant is not None), tenant
 
+    def _echo_headers(self, base: Optional[dict] = None) -> dict:
+        """Response headers + the trace-context echo (satellite of the
+        traceparent contract: EVERY /solve answer names its fleet
+        trace, so an outlier in a client-side report resolves to its
+        trace with no translation table)."""
+        out = dict(base or {})
+        if self._echo_tp:
+            out["traceparent"] = self._echo_tp
+        return out
+
     def _proxy_solve(self, raw: bytes) -> None:
         st = self.rstate
         t0 = time.monotonic()
@@ -576,6 +652,57 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 st.requests_per_tenant[tenant] = (
                     st.requests_per_tenant.get(tenant, 0) + 1
                 )
+        # Distributed tracing (docs/observability.md): adopt the
+        # client's W3C traceparent as the remote parent of a
+        # `router.request` span (minting a fresh trace id for
+        # context-less callers); per-attempt spans/events nest under it
+        # on this handler thread.  An UNtraced router still forwards
+        # the inbound context verbatim (it rides
+        # _FORWARD_REQUEST_HEADERS) and echoes it back.
+        inbound_tp = self.headers.get("traceparent")
+        inbound = tracing.parse_traceparent(inbound_tp)
+        self._trace_id: Optional[str] = None
+        self._echo_tp: Optional[str] = inbound_tp if inbound else None
+        span = None
+        if st.tracer is not None:
+            self._trace_id = (
+                inbound[0] if inbound else tracing.mint_trace_id()
+            )
+            req_w3c = tracing.mint_span_id()
+            self._echo_tp = tracing.format_traceparent(
+                self._trace_id, req_w3c
+            )
+            span = st.tracer.begin(
+                "router.request",
+                {
+                    "request_id": (
+                        self.headers.get("X-Request-Id") or ""
+                    ),
+                    "tenant": tenant or "",
+                    "w3c_id": req_w3c,
+                },
+                remote=(
+                    self._trace_id, inbound[1] if inbound else None
+                ),
+            )
+        status = 0
+        try:
+            status = self._route_solve(raw, t0, tenant)
+        finally:
+            with st._lock:  # noqa: SLF001
+                st.proxy_wall_ms_total += (
+                    (time.monotonic() - t0) * 1e3
+                )
+            if span is not None:
+                st.tracer.end(span, status=status)
+
+    def _route_solve(self, raw: bytes, t0: float,
+                     tenant: Optional[str]) -> int:
+        """The member-retry routing loop; sends the response and
+        returns the status it answered with (the wrapper's span/metric
+        bookkeeping wants it)."""
+        st = self.rstate
+        rid = self.headers.get("X-Request-Id") or ""
         ak = self._affinity_key(raw)
         fwd_headers = {
             h: self.headers[h]
@@ -628,8 +755,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
                             f"router before any replica could serve"
                         ),
                         "deadline_ms": budget_ms,
-                    })
-                    return
+                    }, self._echo_headers())
+                    return 504
                 fwd_headers["X-Deadline-Ms"] = (
                     f"{max(1.0, remaining_ms):.0f}"
                 )
@@ -641,6 +768,21 @@ class _RouterHandler(BaseHTTPRequestHandler):
             if member is not None:
                 with st.table._lock:  # noqa: SLF001
                     member.inflight += 1
+            att_span = None
+            if st.tracer is not None:
+                # A fresh per-attempt wire context under the SAME trace
+                # id: the replica's serve.request adopts it as remote
+                # parent, so each attempt's replica tree hangs under
+                # its own router.attempt span.
+                att_w3c = tracing.mint_span_id()
+                fwd_headers["traceparent"] = tracing.format_traceparent(
+                    self._trace_id, att_w3c
+                )
+                att_span = st.tracer.begin(
+                    "router.attempt",
+                    {"request_id": rid, "member": url,
+                     "attempt": len(tried) + 1, "w3c_id": att_w3c},
+                )
             try:
                 status, body, headers = st.conns.request(
                     url, "POST", "/solve", raw, fwd_headers,
@@ -654,6 +796,19 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     with st.table._lock:  # noqa: SLF001
                         member.inflight = max(0, member.inflight - 1)
             tried.append(url)
+            replica_ms = None
+            if last is not None and status != 0:
+                replica_ms = _server_timing_total_ms(
+                    last[2].get("Server-Timing")
+                )
+            if replica_ms is not None:
+                with st._lock:  # noqa: SLF001
+                    st.upstream_wall_ms_total += replica_ms
+            if att_span is not None:
+                extra = {"status": status}
+                if replica_ms is not None:
+                    extra["replica_ms"] = replica_ms
+                st.tracer.end(att_span, **extra)
             if status == 200 and ak is not None:
                 st.affinity.observe_response(
                     url, ak,
@@ -685,8 +840,19 @@ class _RouterHandler(BaseHTTPRequestHandler):
                         raw = json.dumps(body_obj).encode()
                         with st._lock:  # noqa: SLF001
                             st.resume_handoffs_total += 1
+                        if st.tracer is not None:
+                            st.tracer.event(
+                                "router.drain_handoff",
+                                request_id=rid, from_member=url,
+                                resume_token=token,
+                            )
                     except (ValueError, TypeError):
                         pass
+            if st.tracer is not None:
+                st.tracer.event(
+                    "router.retry", request_id=rid,
+                    from_member=url, status=status,
+                )
         retried = len(tried) > 1
         if last is not None and last[0] not in (0, 503):
             status, body, headers = last
@@ -698,9 +864,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
             st.note_proxied(tried[-1], retried, len(tried) - 1)
             self._send_bytes(
                 status, body,
-                headers.get("Content-Type", "application/json"), out,
+                headers.get("Content-Type", "application/json"),
+                self._echo_headers(out),
             )
-            return
+            return status
         # Exhausted: every member refused (or none exist).  Answer in
         # the replica's own retriable-503 shape so WavetpuClient backs
         # off and retries through the cutover exactly as it would
@@ -719,9 +886,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
             out["X-Wavetpu-Member"] = tried[-1]
             self._send_bytes(
                 503, last[1],
-                last[2].get("Content-Type", "application/json"), out,
+                last[2].get("Content-Type", "application/json"),
+                self._echo_headers(out),
             )
-            return
+            return 503
         self._send(503, {
             "status": "error",
             "error": (
@@ -729,7 +897,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 if tried else "fleet has no routable members"
             ),
             "retriable": True,
-        }, {"Retry-After": "2"})
+        }, self._echo_headers({"Retry-After": "2"}))
+        return 503
 
     def _retry_pick(self, candidates) -> str:
         """Retry attempts skip the affinity counters (one request, one
@@ -754,13 +923,15 @@ def build_router(
     start_poller: bool = True,
     min_retry_budget_ms: float = 50.0,
     api_keys: Optional[Dict[str, str]] = None,
+    telemetry_dir: Optional[str] = None,
 ) -> Tuple[ThreadingHTTPServer, RouterState]:
     """Assemble membership + affinity + HTTP front (port 0 =
     ephemeral).  Does ONE synchronous poll before returning so the
     rotation is populated the moment the caller starts serving; the
     periodic poller (start_poller) keeps it fresh.  Returned httpd is
     not yet serving - call serve_forever() (main does) or drive it
-    from a thread (tests do)."""
+    from a thread (tests do).  `telemetry_dir` turns on the router's
+    own span tracing (DIR/trace.jsonl, rotated like a replica's)."""
     affinity = AffinityTable(rng=rng)
     table = MembershipTable(
         member_urls, fail_threshold=fail_threshold, fetch=fetch,
@@ -771,6 +942,11 @@ def build_router(
         max_body_bytes=max_body_bytes,
         min_retry_budget_ms=min_retry_budget_ms, api_keys=api_keys,
     )
+    if telemetry_dir is not None:
+        state.tracer = tracing.Tracer(
+            os.path.join(telemetry_dir, TRACE_FILENAME),
+            max_bytes=DEFAULT_MAX_BYTES, keep=ROTATE_KEEP,
+        )
     table.poll_once()
     httpd = ThreadingHTTPServer((host, port), _RouterHandler)
     httpd.wavetpu_router = state
@@ -787,7 +963,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             known=("member", "host", "port", "poll-interval-s",
                    "fail-threshold", "proxy-timeout-s",
                    "max-body-bytes", "min-retry-budget-ms",
-                   "api-keys-file"),
+                   "api-keys-file", "telemetry-dir"),
             allow_positionals=False,
             repeatable=("member",),
         )
@@ -819,10 +995,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         poll_interval_s=poll_interval_s, fail_threshold=fail_threshold,
         proxy_timeout=proxy_timeout, max_body_bytes=max_body_bytes,
         min_retry_budget_ms=min_retry_budget_ms, api_keys=api_keys,
+        telemetry_dir=flags.get("telemetry-dir"),
     )
     if api_keys is not None:
         print(f"api keys: {len(api_keys)} key(s) -> "
               f"{len(set(api_keys.values()))} tenant(s)")
+    if state.tracer is not None:
+        print(f"telemetry: router spans -> {state.tracer.path}")
     bound = httpd.server_address
     up = len(state.table.routable_urls())
     print(
@@ -845,6 +1024,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     finally:
         state.stop_poller()
         httpd.server_close()
+        if state.tracer is not None:
+            state.tracer.close()
     print("wavetpu router: shut down")
     return 0
 

@@ -219,13 +219,16 @@ def build_report(result, trace_path: Optional[str] = None,
         "server": server,
         # The join handles: feed any of these to
         # `wavetpu trace-report --request ID` against the server's
-        # telemetry dir to see that exact request's critical path.
+        # telemetry dir(s) to see that exact request's critical path;
+        # `traceparent` carries the fleet trace id the request rode
+        # across the router and every replica it touched.
         "slowest_requests": [
             {
                 "request_id": o.request_id,
                 "scenario": o.scenario,
                 "status": o.status,
                 "latency_ms": round(o.latency_s * 1e3, 3),
+                "traceparent": getattr(o, "traceparent", ""),
             }
             for o in slowest
         ],

@@ -42,6 +42,9 @@ import urllib.error
 import urllib.request
 from typing import Dict, List, Optional, Sequence, Union
 
+from wavetpu.obs.tracing import format_traceparent, mint_span_id, \
+    mint_trace_id
+
 
 class PreflightError(RuntimeError):
     """The target server failed the health preflight - replaying a
@@ -154,6 +157,8 @@ class RequestOutcome:
     error: Optional[str] = None
     attempts: int = 1
     target: str = ""       # which --target URL served this request
+    traceparent: str = ""  # W3C context the request carried (fleet
+                           # trace join handle for trace-report)
 
 
 @dataclasses.dataclass
@@ -198,13 +203,16 @@ def _post_one(base_url: str, index: int, rec: dict, rid: str,
             ),
             error=out.error, attempts=out.attempts,
             target=base_url.rstrip("/"),
+            traceparent=out.traceparent,
         )
     body = json.dumps(rec["body"]).encode()
+    traceparent = format_traceparent(mint_trace_id(), mint_span_id())
     req = urllib.request.Request(
         base_url.rstrip("/") + "/solve", data=body,
         headers={
             "Content-Type": "application/json",
             "X-Request-Id": rid,
+            "traceparent": traceparent,
         },
     )
     t0 = time.perf_counter()
@@ -227,7 +235,7 @@ def _post_one(base_url: str, index: int, rec: dict, rid: str,
         index=index, scenario=rec.get("scenario", "?"), request_id=rid,
         status=status, latency_s=time.perf_counter() - t0,
         t_sent=t_sent, server_timing=timing, error=err,
-        target=base_url.rstrip("/"),
+        target=base_url.rstrip("/"), traceparent=traceparent,
     )
 
 

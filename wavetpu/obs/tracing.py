@@ -36,6 +36,19 @@ span is not a child of whatever the HTTP thread had open).  Cross-thread
 stories - one serve request enqueued on thread A and executed on thread
 B - are stitched by shared ATTRIBUTES instead (`request_id` /
 `request_ids`), which `wavetpu trace-report --request` joins on.
+
+Cross-PROCESS linkage (the fleet story) rides W3C trace context:
+`parse_traceparent` / `format_traceparent` speak the `traceparent`
+header (`00-{32-hex trace id}-{16-hex parent id}-{flags}`), and
+`begin()` accepts `remote=(trace_id, parent_id)` to adopt an inbound
+context as the span's parent.  Internal span ids stay `{pid:x}-{n}`;
+a FORWARDING span (router attempt, serve request) additionally mints a
+16-hex W3C id, records it as its `w3c_id` attr, and sends it downstream
+as the traceparent parent - the trace joiner (obs/report.py) resolves
+`w3c_id -> span_id` at merge time, so one request's spans across the
+client, the router, and N replicas share one `trace_id` and one tree.
+Preemption resume chains that cross requests use record-level `links`
+(`[{"trace_id": ..., "span_id": ...}]`) instead of parenthood.
 """
 
 from __future__ import annotations
@@ -47,7 +60,63 @@ import os
 import sys
 import threading
 import time
-from typing import Optional
+from typing import List, Optional, Tuple
+
+
+# ------------------------------------------- W3C trace context (fleet)
+
+_ZERO_TRACE = "0" * 32
+_ZERO_SPAN = "0" * 16
+
+
+def mint_trace_id() -> str:
+    """A fresh 32-hex W3C trace id (crypto-random, never all-zero)."""
+    while True:
+        tid = os.urandom(16).hex()
+        if tid != _ZERO_TRACE:
+            return tid
+
+
+def mint_span_id() -> str:
+    """A fresh 16-hex W3C span id for the wire (the `traceparent`
+    parent-id field).  Internal span ids stay `{pid:x}-{n}`; this is
+    only what a FORWARDING span advertises downstream."""
+    while True:
+        sid = os.urandom(8).hex()
+        if sid != _ZERO_SPAN:
+            return sid
+
+
+def format_traceparent(trace_id: str, parent_id: str,
+                       flags: str = "01") -> str:
+    """`00-{trace_id}-{parent_id}-{flags}` (W3C Trace Context v00)."""
+    return f"00-{trace_id}-{parent_id}-{flags}"
+
+
+def parse_traceparent(header: Optional[str]
+                      ) -> Optional[Tuple[str, str]]:
+    """`traceparent` header -> (trace_id, parent_id), or None for
+    anything malformed (wrong field count/width, non-hex, all-zero ids,
+    the reserved version ff).  Garbage from an arbitrary proxy must
+    degrade to 'untraced', never to a crash or a poisoned trace id."""
+    if not isinstance(header, str):
+        return None
+    parts = header.strip().lower().split("-")
+    if len(parts) != 4:
+        return None
+    version, trace_id, parent_id, flags = parts
+    if (len(version), len(trace_id), len(parent_id), len(flags)) != \
+            (2, 32, 16, 2):
+        return None
+    try:
+        int(version, 16), int(trace_id, 16)
+        int(parent_id, 16), int(flags, 16)
+    except ValueError:
+        return None
+    if version == "ff" or trace_id == _ZERO_TRACE \
+            or parent_id == _ZERO_SPAN:
+        return None
+    return trace_id, parent_id
 
 
 def rotate_file(path: str, keep: int) -> None:
@@ -70,6 +139,9 @@ def rotate_file(path: str, keep: int) -> None:
             os.replace(src, f"{path}.{i}")
 
 
+_tracer_instances = itertools.count()
+
+
 class Tracer:
     """JSONL span writer bound to one output file (append mode).
 
@@ -90,7 +162,18 @@ class Tracer:
         self._f = open(path, "a", encoding="utf-8")
         self._wlock = threading.Lock()
         self._ids = itertools.count(1)
-        self._prefix = f"{os.getpid():x}"
+        # Span ids are `{prefix}-{n}`.  The prefix must be unique PER
+        # TRACER, not just per process: a router and an in-process
+        # replica (tests, bench) each own a Tracer, and two id
+        # namespaces both rooted at the bare pid would collide on
+        # `{pid:x}-1` - corrupting the joiner's by-id maps.  The first
+        # tracer in a process keeps the plain pid (the production
+        # one-tracer-per-process shape); later instances get a distinct
+        # `{pid}t{k}` namespace.
+        n = next(_tracer_instances)
+        self._prefix = (
+            f"{os.getpid():x}" if n == 0 else f"{os.getpid():x}t{n}"
+        )
         self._local = threading.local()
 
     # -- ids / stack ---------------------------------------------------
@@ -106,7 +189,13 @@ class Tracer:
 
     def current_span_id(self) -> Optional[str]:
         st = self._stack()
-        return st[-1] if st else None
+        return st[-1][0] if st else None
+
+    def current_trace_id(self) -> Optional[str]:
+        """The W3C trace id of the innermost open span on THIS thread
+        (None when untraced / no span open) - child spans inherit it."""
+        st = self._stack()
+        return st[-1][1] if st else None
 
     # -- emission ------------------------------------------------------
 
@@ -130,10 +219,23 @@ class Tracer:
         except (OSError, ValueError):
             pass
 
-    def begin(self, kind: str, attrs: dict, /) -> dict:
+    def begin(self, kind: str, attrs: dict, /,
+              remote: Optional[Tuple[str, Optional[str]]] = None,
+              links: Optional[List[dict]] = None,
+              trace_id: Optional[str] = None) -> dict:
         """Open a span; returns the handle `end()` wants.  Also opens a
         matching jax.profiler.TraceAnnotation when jax is already loaded
-        so application spans land in `--profile` device traces."""
+        so application spans land in `--profile` device traces.
+
+        `remote=(trace_id, parent_id)` adopts an INBOUND W3C context
+        (another process's traceparent) as the parent instead of this
+        thread's stack: parent_id may be a 16-hex wire id (the joiner
+        resolves it against the sender's `w3c_id` attr) or None for a
+        trace root.  `trace_id` alone stamps the record's trace id
+        without touching parenthood (a scheduler-thread chunk span that
+        belongs to a request's trace but is not its tree child).
+        `links` attaches record-level cross-trace links (the preemption
+        resume chain)."""
         annotation = None
         jax = sys.modules.get("jax")
         if jax is not None:
@@ -142,16 +244,25 @@ class Tracer:
                 annotation.__enter__()
             except Exception:
                 annotation = None
+        if remote is not None:
+            parent_id: Optional[str] = remote[1]
+            trace_id = remote[0]
+        else:
+            parent_id = self.current_span_id()
+            if trace_id is None:
+                trace_id = self.current_trace_id()
         handle = {
             "kind": kind,
             "span_id": self.new_id(),
-            "parent_id": self.current_span_id(),
+            "parent_id": parent_id,
+            "trace_id": trace_id,
+            "links": list(links) if links else None,
             "t_start": time.time(),
             "_t0": time.perf_counter(),
             "_annotation": annotation,
             "attrs": attrs,
         }
-        self._stack().append(handle["span_id"])
+        self._stack().append((handle["span_id"], trace_id))
         return handle
 
     def end(self, handle: dict, **extra_attrs) -> None:
@@ -163,10 +274,13 @@ class Tracer:
             # exception) or emit a duplicate record.
             return
         st = self._stack()
-        if st and st[-1] == handle["span_id"]:
+        if st and st[-1][0] == handle["span_id"]:
             st.pop()
-        elif handle["span_id"] in st:  # unbalanced begin/end: recover
-            st.remove(handle["span_id"])
+        else:  # unbalanced begin/end: recover
+            for i, (sid, _tid) in enumerate(st):
+                if sid == handle["span_id"]:
+                    del st[i]
+                    break
         annotation = handle.pop("_annotation", None)
         if annotation is not None:
             try:
@@ -175,7 +289,7 @@ class Tracer:
                 pass
         handle["attrs"] = dict(handle["attrs"], **extra_attrs)
         dur = time.perf_counter() - t0
-        self._write({
+        record = {
             "type": "span",
             "kind": handle["kind"],
             "span_id": handle["span_id"],
@@ -184,18 +298,27 @@ class Tracer:
             "t_start": round(handle["t_start"], 6),
             "dur_s": round(dur, 6),
             "attrs": handle["attrs"],
-        })
+        }
+        if handle.get("trace_id") is not None:
+            record["trace_id"] = handle["trace_id"]
+        if handle.get("links"):
+            record["links"] = handle["links"]
+        self._write(record)
 
     @contextlib.contextmanager
-    def span(self, kind: str, /, **attrs):
-        handle = self.begin(kind, attrs)
+    def span(self, kind: str, /,
+             remote: Optional[Tuple[str, Optional[str]]] = None,
+             links: Optional[List[dict]] = None,
+             trace_id: Optional[str] = None, **attrs):
+        handle = self.begin(kind, attrs, remote=remote, links=links,
+                            trace_id=trace_id)
         try:
             yield handle["attrs"]
         finally:
             self.end(handle)
 
     def event(self, kind: str, /, **attrs) -> None:
-        self._write({
+        record = {
             "type": "event",
             "kind": kind,
             "span_id": self.new_id(),
@@ -203,7 +326,11 @@ class Tracer:
             "thread": threading.current_thread().name,
             "t_start": round(time.time(), 6),
             "attrs": attrs,
-        })
+        }
+        tid = self.current_trace_id()
+        if tid is not None:
+            record["trace_id"] = tid
+        self._write(record)
 
     def close(self) -> None:
         with self._wlock:
@@ -248,20 +375,29 @@ def enabled() -> bool:
 
 
 @contextlib.contextmanager
-def span(kind: str, /, **attrs):
+def span(kind: str, /, remote: Optional[Tuple[str, Optional[str]]] = None,
+         links: Optional[List[dict]] = None,
+         trace_id: Optional[str] = None, **attrs):
     """Module-level span: no-op (fresh throwaway attrs dict) when no
     tracer is configured, so instrumented paths cost nothing untraced."""
     t = _tracer
     if t is None:
         yield attrs
         return
-    with t.span(kind, **attrs) as a:
+    with t.span(kind, remote=remote, links=links, trace_id=trace_id,
+                **attrs) as a:
         yield a
 
 
-def begin_span(kind: str, /, **attrs) -> Optional[dict]:
+def begin_span(kind: str, /,
+               remote: Optional[Tuple[str, Optional[str]]] = None,
+               links: Optional[List[dict]] = None,
+               trace_id: Optional[str] = None, **attrs
+               ) -> Optional[dict]:
     t = _tracer
-    return None if t is None else t.begin(kind, attrs)
+    return None if t is None else t.begin(
+        kind, attrs, remote=remote, links=links, trace_id=trace_id
+    )
 
 
 def end_span(handle: Optional[dict], **extra_attrs) -> None:

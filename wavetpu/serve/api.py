@@ -514,7 +514,31 @@ class _Handler(BaseHTTPRequestHandler):
         # on the join key without any translation table.
         rid = sanitize_request_id(self.headers.get("X-Request-Id"))
         rid = rid or tracing.new_id()
-        span = tracing.begin_span("serve.request", request_id=rid)
+        # Fleet trace adoption (docs/observability.md "Distributed
+        # tracing"): an inbound W3C `traceparent` (a router attempt, or
+        # a bare WavetpuClient) becomes the REMOTE parent of this
+        # serve.request span, so the replica's whole tree hangs under
+        # the fleet trace id; a traced request with no inbound context
+        # mints its own trace id.  The span advertises a 16-hex
+        # `w3c_id` the joiner resolves cross-process, and the context
+        # is echoed on the response either way - even untraced, the
+        # inbound header is reflected so the client's join handle
+        # always answers.
+        inbound_tp = self.headers.get("traceparent")
+        ctx = tracing.parse_traceparent(inbound_tp)
+        echo_tp = inbound_tp if ctx else None
+        span = None
+        self._trace_context: Optional[Tuple[str, str]] = None
+        if tracing.enabled():
+            trace_id = ctx[0] if ctx else tracing.mint_trace_id()
+            w3c = tracing.mint_span_id()
+            echo_tp = tracing.format_traceparent(trace_id, w3c)
+            self._trace_context = (trace_id, w3c)
+            span = tracing.begin_span(
+                "serve.request",
+                remote=(trace_id, ctx[1] if ctx else None),
+                request_id=rid, w3c_id=w3c,
+            )
         code = None
         headers: dict = {}
         try:
@@ -528,6 +552,8 @@ class _Handler(BaseHTTPRequestHandler):
             )
         if rid:
             headers.setdefault("X-Request-Id", rid)
+        if echo_tp:
+            headers.setdefault("traceparent", echo_tp)
         self._send(code, payload, headers)
 
     def _handle_solve(self, rid) -> Tuple[int, dict, dict]:
@@ -622,8 +648,10 @@ class _Handler(BaseHTTPRequestHandler):
             # recorded trace replays cleanly instead of re-issuing junk.
             st.recorder.record(body, request_id=rid)
         try:
-            fut = st.batcher.submit(req, request_id=rid,
-                                    deadline=deadline)
+            fut = st.batcher.submit(
+                req, request_id=rid, deadline=deadline,
+                trace_context=getattr(self, "_trace_context", None),
+            )
         except QueueFullError as e:
             # Bounded-queue backpressure: shed load NOW instead of
             # stacking latency the client will time out on anyway,

@@ -116,8 +116,15 @@ class SolveStateStore:
         )
 
     def put(self, identity: dict, state: Sequence, step: int,
-            abs_errors: np.ndarray, rel_errors: np.ndarray) -> str:
-        """Checkpoint `state` (layers up to `step` marched) -> token."""
+            abs_errors: np.ndarray, rel_errors: np.ndarray,
+            origin_trace: Optional[Sequence[str]] = None) -> str:
+        """Checkpoint `state` (layers up to `step` marched) -> token.
+
+        `origin_trace` is the originating request's (trace id, span id)
+        pair; it rides in the meta blob so a resuming replica can link
+        its chunk spans back to the trace where the march began.  Load
+        identity verification only reads `_IDENTITY_FIELDS`, so the
+        extra key never affects token acceptance."""
         from wavetpu.io.checkpoint import _encode_field
 
         arrays = {}
@@ -130,6 +137,8 @@ class SolveStateStore:
         meta["step"] = int(step)
         meta["nstate"] = len(tags)
         meta["state_tags"] = tags
+        if origin_trace is not None:
+            meta["origin_trace"] = [str(x) for x in origin_trace]
         arrays["meta"] = np.frombuffer(
             json.dumps(meta, sort_keys=True).encode("utf-8"),
             dtype=np.uint8,

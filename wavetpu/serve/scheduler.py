@@ -216,6 +216,11 @@ class ServeMetrics:
             "solve requests by router-stamped tenant label",
             ("tenant",),
         )
+        self._inflight_chunks = r.gauge(
+            "wavetpu_serve_inflight_chunk_marches",
+            "chunked long solves currently mid-march (march state "
+            "held between scheduler rounds; survives worker crashes)",
+        )
         # Exact-percentile reservoir for the JSON snapshot's historical
         # latency_p50/p95_ms fields (the histogram above serves
         # Prometheus); guarded by the REGISTRY lock so snapshot() is one
@@ -248,6 +253,12 @@ class ServeMetrics:
 
     def observe_chunk(self) -> None:
         self._chunks.inc()
+
+    def observe_chunk_march_started(self) -> None:
+        self._inflight_chunks.inc()
+
+    def observe_chunk_march_ended(self) -> None:
+        self._inflight_chunks.dec()
 
     def observe_preempted(self, reason: str) -> None:
         self._preempted.inc(reason=reason)
@@ -388,6 +399,11 @@ class _Item:
     # request).
     chunked: bool = False
     chunk: Optional["_ChunkProgress"] = None
+    # Fleet trace context the HTTP layer adopted/minted for this
+    # request: (32-hex trace id, 16-hex serve.request wire id), None
+    # untraced.  Chunk spans stamp the trace id, and checkpoints
+    # persist it so a resume on another replica links back.
+    trace_context: Optional[Tuple[str, str]] = None
 
 
 class _ChunkProgress:
@@ -398,6 +414,7 @@ class _ChunkProgress:
     __slots__ = (
         "runner", "state", "step", "abs", "rel", "chunks_done",
         "wait_s", "compile_s", "execute_s", "warm", "resumed_from",
+        "origin_trace",
     )
 
     def __init__(self, runner, warm: str, compile_s: float,
@@ -416,6 +433,11 @@ class _ChunkProgress:
         self.execute_s = 0.0
         self.warm = warm
         self.resumed_from: Optional[int] = None
+        # [trace_id, span_w3c_id] of the ORIGINATING request: minted on
+        # the first march, carried through checkpoints, so the chunk
+        # spans of a solve resumed on another replica (or under a fresh
+        # client trace) still link back to where the march began.
+        self.origin_trace: Optional[List[str]] = None
 
 
 class DynamicBatcher:
@@ -598,10 +620,14 @@ class DynamicBatcher:
 
     def submit(self, request: SolveRequest,
                request_id: Optional[str] = None,
-               deadline: Optional[float] = None) -> Future:
+               deadline: Optional[float] = None,
+               trace_context: Optional[Tuple[str, str]] = None) -> Future:
         """`deadline` is an absolute `time.monotonic()` bound (None =
         unbounded, the historical behavior): the worker drops the item
-        with `DeadlineExceededError` if it is still queued past it."""
+        with `DeadlineExceededError` if it is still queued past it.
+        `trace_context` is the serving span's (trace id, wire span id):
+        chunk spans stamp the trace id and checkpoints carry it so
+        resumed marches link back to the originating request."""
         chunked = self._chunk_mode(request)
         if chunked:
             # A unique key: chunked items never coalesce with (or get
@@ -616,6 +642,7 @@ class DynamicBatcher:
             request, Future(), key,
             request_id=request_id, enqueued=time.monotonic(),
             deadline=deadline, chunked=chunked,
+            trace_context=trace_context,
         )
         # Closed-check + enqueue are ATOMIC against close() (which
         # flips _closed under this same lock): a submit that passes the
@@ -974,6 +1001,7 @@ class DynamicBatcher:
                 cp.runner.identity,
                 cp.runner.state_to_numpy(cp.state),
                 cp.step, cp.abs, cp.rel,
+                origin_trace=cp.origin_trace,
             )
         except Exception:
             return None
@@ -1029,7 +1057,7 @@ class DynamicBatcher:
 
                     if _os.path.exists(target):
                         faults.truncate_tail(target)
-                _, step, state_np, abs_p, rel_p = (
+                meta, step, state_np, abs_p, rel_p = (
                     self.state_store.load(
                         req.resume_token, cp.runner.identity
                     )
@@ -1039,6 +1067,15 @@ class DynamicBatcher:
                 cp.abs[: step + 1] = abs_p
                 cp.rel[: step + 1] = rel_p
                 cp.resumed_from = step
+                # Prefer the checkpoint's origin: even when the resume
+                # arrives under a fresh client trace, the chunk spans
+                # link back to the march's FIRST request.
+                origin = meta.get("origin_trace")
+                if (isinstance(origin, (list, tuple)) and len(origin) == 2
+                        and all(isinstance(x, str) for x in origin)):
+                    cp.origin_trace = list(origin)
+                elif item.trace_context is not None:
+                    cp.origin_trace = list(item.trace_context)
                 self.metrics.observe_resume("token")
             else:
                 state, abs2, rel2, boot_c, boot_s = cp.runner.bootstrap()
@@ -1048,7 +1085,17 @@ class DynamicBatcher:
                 cp.rel[:2] = rel2
                 cp.compile_s += boot_c
                 cp.execute_s += boot_s
+                if item.trace_context is not None:
+                    cp.origin_trace = list(item.trace_context)
             item.chunk = cp
+            self.metrics.observe_chunk_march_started()
+            # The future resolves EXACTLY once regardless of how the
+            # march ends (completion, drain/deadline preemption with a
+            # token, watchdog trip, close-sweep failure, crash fail) -
+            # the one safe place to decrement the in-flight gauge.
+            item.future.add_done_callback(
+                lambda _f: self.metrics.observe_chunk_march_ended()
+            )
             return False
         except Exception as e:
             if not item.future.done():
@@ -1131,11 +1178,23 @@ class DynamicBatcher:
                 time.sleep(slow.seconds)
         length = cp.runner.next_length(cp.step)
         compile_ledger.set_request_context(tenant=req.tenant)
+        # Chunk spans run on the scheduler thread, outside the serving
+        # request's span stack: stamp the trace id explicitly, and when
+        # this march was resumed from another request's checkpoint link
+        # back to the originating trace so the joiner can stitch a
+        # preempted-and-resumed solve into ONE tree.
+        tc = item.trace_context
+        origin = cp.origin_trace
+        span_trace = tc[0] if tc else (origin[0] if origin else None)
+        links = None
+        if origin is not None and origin[0] != span_trace:
+            links = [{"trace_id": origin[0], "span_id": origin[1]}]
         try:
             with tracing.span(
                 "serve.chunk", request_id=item.request_id,
                 tenant=req.tenant, path=req.path, start=cp.step,
                 length=length, n=req.problem.N,
+                trace_id=span_trace, links=links,
             ):
                 state, abs_c, rel_c, solve_s, compile_s = (
                     cp.runner.chunk(cp.state, cp.step, length)
