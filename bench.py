@@ -1086,6 +1086,152 @@ def _dtrace_row(interp):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _qos_row(interp):
+    """Multi-tenant QoS priced: the class-aware scheduler's rent plus
+    the isolation proof.  Arm 1 (overhead A/B): one warmed replica
+    built with the QoS machinery fully on (class-aware WDRR batcher +
+    brownout controller) vs one built with brownout off - the trace
+    carries no priority fields, so both arms ride the single-class
+    FIFO fast path on byte-identical /solve payloads, and the p95
+    delta is the pure QoS bookkeeping rent, bar <= 2%.  Arm 2
+    (isolation drill): a cells-quota-limited aggressor floods
+    oversized best_effort solves through the router while the victim
+    tenant replays the interactive mix - victim p95 must hold <= 1.5x
+    its unloaded run with zero errors, and the aggressor's overage
+    429s (refill-priced Retry-After) are absorbed by the retrying
+    WavetpuClient and land in the router's per-tenant quota counters."""
+    import threading
+    import traceback
+
+    from wavetpu.fleet import quota
+    from wavetpu.fleet.router import build_router
+    from wavetpu.loadgen import report as lg_report
+    from wavetpu.loadgen import runner, trace
+    from wavetpu.serve.api import build_server
+
+    n, steps, kernel = (8, 6, "roll") if interp else (64, 20, "auto")
+    scenarios = trace.default_scenarios(n=n, timesteps=steps)
+    plain = trace.generate(
+        "poisson", duration=3.0, qps=6.0, scenarios=scenarios, seed=31
+    )
+
+    def serve(**kw):
+        httpd, state = build_server(
+            port=0, max_wait=0.02, default_kernel=kernel,
+            interpret=interp, **kw,
+        )
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd, state, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    def run(base, recs, mode="closed", warmup=0, retries=0):
+        res = runner.replay(
+            base, recs, mode=mode, concurrency=4, warmup=warmup,
+            timeout=1800, retries=retries,
+        )
+        return lg_report.build_report(res, target=base)
+
+    try:
+        # Arm 1: identical single-class replay, QoS on vs brownout off.
+        h_on, s_on, u_on = serve()
+        h_off, s_off, u_off = serve(brownout=False)
+        try:
+            run(u_on, plain, warmup=len(scenarios))
+            run(u_off, plain, warmup=len(scenarios))
+            rep_on = run(u_on, plain)
+            rep_off = run(u_off, plain)
+        finally:
+            for h, s in ((h_on, s_on), (h_off, s_off)):
+                h.shutdown()
+                s.batcher.close()
+                h.server_close()
+        p95_on = rep_on["latency_ms"]["p95_ms"]
+        p95_off = rep_off["latency_ms"]["p95_ms"]
+
+        # Arm 2: aggressor-vs-victim through a quota-enforcing router.
+        # The aggressor's cells budget admits ~half its offered rate,
+        # so the overage 429s while the victim rides WDRR interactive.
+        secret = "bench-qos-secret"
+        tens = trace.gen_tenants(
+            3.0, 8.0, scenarios, seed=37, victim_frac=0.5,
+            victim_key="vk", aggressor_key="ak", aggressor_mult=4,
+        )
+        victim_only = [r for r in tens if r.get("tenant") == "victim"]
+        agg_cells = quota.price_cells(
+            next(r["body"] for r in tens if r["tenant"] == "aggressor")
+        )
+        keys = {
+            "vk": quota.TenantConfig(
+                tenant="victim", priority="interactive"
+            ),
+            "ak": quota.TenantConfig(
+                tenant="aggressor", priority="best_effort",
+                priority_ceiling="best_effort",
+                cells_per_s=agg_cells * 2.0, cells_burst=agg_cells * 2.0,
+            ),
+        }
+        h1, s1, u1 = serve(proxy_token=secret)
+        try:
+            rh, rs = build_router(
+                [u1], poll_interval_s=0.5, api_keys=keys,
+                proxy_token=secret,
+            )
+            threading.Thread(
+                target=rh.serve_forever, daemon=True
+            ).start()
+            ru = f"http://127.0.0.1:{rh.server_address[1]}"
+            try:
+                run(ru, tens, retries=3)  # warm both tier programs
+                rep_unloaded = run(
+                    ru, victim_only, mode="open", retries=3
+                )
+                rep_loaded = run(ru, tens, mode="open", retries=3)
+                snap = rs.snapshot()
+            finally:
+                rs.stop_poller()
+                rh.shutdown()
+                rh.server_close()
+        finally:
+            h1.shutdown()
+            s1.batcher.close()
+            h1.server_close()
+        v_un = rep_unloaded["latency_ms"]["p95_ms"]
+        v_row = (rep_loaded.get("tenants") or {}).get("victim", {})
+        a_row = (rep_loaded.get("tenants") or {}).get("aggressor", {})
+        rejected = (snap.get("quota_rejected_per_tenant") or {})
+        return {
+            "qos_on_p95_ms": p95_on,
+            "qos_off_p95_ms": p95_off,
+            "qos_overhead_p95_pct": round(
+                100.0 * (p95_on - p95_off) / p95_off, 2
+            ) if p95_off else None,
+            "victim_unloaded_p95_ms": v_un,
+            "victim_loaded_p95_ms": v_row.get("p95_ms"),
+            "victim_p95_ratio": round(
+                v_row["p95_ms"] / v_un, 3
+            ) if v_un and v_row.get("p95_ms") else None,
+            "victim_errors": v_row.get("errors"),
+            "aggressor_quota_429s": rejected.get("aggressor", 0),
+            "aggressor_retried_requests": a_row.get(
+                "retried_requests"
+            ),
+            "aggressor_errors": a_row.get("errors"),
+            "policy": "best_of_1",
+            "config": (
+                f"N={n}/{steps} kernel={kernel}; arm1 = poisson mix "
+                f"{len(plain)} reqs closed c=4, QoS-on vs brownout-off "
+                f"on byte-identical payloads, bar <= 2% p95; arm2 = "
+                f"tenants mix {len(tens)} reqs open loop through "
+                f"router[1 member], aggressor cells quota = 2 req/s of "
+                f"~4 offered, victim bar <= 1.5x unloaded p95 with 0 "
+                f"errors, aggressor 429s absorbed by retries=3"
+            ),
+        }
+    except Exception:
+        print("qos sub-benchmark failed:", file=sys.stderr)
+        traceback.print_exc()
+        return {"error": "failed; see stderr"}
+
+
 def _occupancy_sweep(interp):
     """Batch-occupancy vs max_wait: the tail-latency/occupancy knob
     measured.  8 requests arrive ~10 ms apart at a max_batch=8 batcher;
@@ -1479,6 +1625,11 @@ def main() -> int:
     # Distributed tracing: router+replica replay traced on both tiers
     # vs untraced (<= 2% p95 bar) + the merged cross-process join proof.
     subs["dtrace"] = _dtrace_row(interp)
+    # Multi-tenant QoS: class-aware scheduler + brownout rent (<= 2%
+    # p95 bar on byte-identical single-class payloads) and the
+    # aggressor-vs-victim isolation drill (victim p95 <= 1.5x unloaded,
+    # zero victim errors, aggressor quota 429s absorbed by retries).
+    subs["qos"] = _qos_row(interp)
     line = {
         "metric": "gcell_updates_per_s",
         "value": head["gcells_per_s"],
@@ -1580,6 +1731,12 @@ def main() -> int:
             "dtrace_overhead_p95_pct"
         ),
         "dtrace_join_ok": subs["dtrace"].get("join_ok"),
+        "qos_overhead_p95_pct": subs["qos"].get(
+            "qos_overhead_p95_pct"
+        ),
+        "qos_victim_p95_ratio": subs["qos"].get("victim_p95_ratio"),
+        "qos_victim_errors": subs["qos"].get("victim_errors"),
+        "qos_aggressor_429s": subs["qos"].get("aggressor_quota_429s"),
         "headline_summary": True,
     }
     print(json.dumps(summary))

@@ -793,9 +793,37 @@ class TestRouterApiKeys:
 
     def test_load_api_keys_parses_and_validates(self, tmp_path):
         p = tmp_path / "keys.json"
+        # PR-12 flat schema: plain tenant-label strings normalize to
+        # identity-only configs (no quotas, default classes).
         p.write_text(json.dumps({"k1": "acme", "k2": "umbrella"}))
-        assert load_api_keys(str(p)) == {"k1": "acme", "k2": "umbrella"}
-        for bad in (["k1"], {}, {"k": 5}, {"": "t"}, {"k": ""}):
+        keys = load_api_keys(str(p))
+        assert {k: c.tenant for k, c in keys.items()} == {
+            "k1": "acme", "k2": "umbrella"
+        }
+        assert keys["k1"].rps is None
+        assert keys["k1"].cells_per_s is None
+        assert keys["k1"].priority == "batch"
+        assert keys["k1"].priority_ceiling == "interactive"
+        # QoS schema: config objects carry quota + class policy; a
+        # default class above the ceiling is clamped at parse time.
+        p.write_text(json.dumps({
+            "k1": "acme",
+            "k2": {"tenant": "umbrella", "priority": "interactive",
+                   "priority_ceiling": "batch", "rps": 5,
+                   "burst": 10, "cells_per_s": 1e6},
+        }))
+        keys = load_api_keys(str(p))
+        assert keys["k1"].tenant == "acme"
+        c = keys["k2"]
+        assert c.tenant == "umbrella"
+        assert c.priority == "batch"  # clamped at the ceiling
+        assert c.priority_ceiling == "batch"
+        assert c.rps == 5 and c.burst == 10 and c.cells_per_s == 1e6
+        assert c.cells_burst is None
+        for bad in (["k1"], {}, {"k": 5}, {"": "t"}, {"k": ""},
+                    {"k": {}}, {"k": {"tenant": ""}},
+                    {"k": {"tenant": "t", "rps": 0}},
+                    {"k": {"tenant": "t", "rps": "fast"}}):
             p.write_text(json.dumps(bad))
             with pytest.raises(ValueError):
                 load_api_keys(str(p))

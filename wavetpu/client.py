@@ -146,6 +146,7 @@ class WavetpuClient:
         backoff_max_s: float = 2.0,
         rng: Optional[random.Random] = None,
         sleep: Callable[[float], None] = time.sleep,
+        headers: Optional[Dict[str, str]] = None,
     ):
         if retries < 0:
             raise ValueError(f"retries must be >= 0, got {retries}")
@@ -169,6 +170,10 @@ class WavetpuClient:
         self.backoff_max_s = backoff_max_s
         self._rng = rng if rng is not None else random.Random()
         self._sleep = sleep
+        # Extra request headers on EVERY /solve attempt - how a caller
+        # authenticates (X-Api-Key / Authorization) and declares its
+        # priority class (X-Priority) against a QoS-enabled router.
+        self.headers: Dict[str, str] = dict(headers or {})
         self._n = 0
         self._tag = f"{int(time.time() * 1e3) & 0xFFFFFFFF:x}"
         self._local = threading.local()
@@ -246,12 +251,14 @@ class WavetpuClient:
         return resp.status, raw, dict(resp.headers)
 
     def _attempt(self, body: dict, rid: str, timeout: float,
-                 traceparent: str = ""):
+                 traceparent: str = "",
+                 extra_headers: Optional[Dict[str, str]] = None):
         """One POST /solve: (status, payload, headers, error)."""
-        headers = {
-            "Content-Type": "application/json",
-            "X-Request-Id": rid,
-        }
+        headers = dict(self.headers)
+        if extra_headers:
+            headers.update(extra_headers)
+        headers["Content-Type"] = "application/json"
+        headers["X-Request-Id"] = rid
         if traceparent:
             headers["traceparent"] = traceparent
         try:
@@ -285,15 +292,21 @@ class WavetpuClient:
         deadline_s: Optional[float] = None,
         retries: Optional[int] = None,
         timeout: Optional[float] = None,
+        headers: Optional[Dict[str, str]] = None,
     ) -> SolveOutcome:
         """POST /solve with retry/backoff/deadline per the class doc.
         The per-call kwargs override the client defaults; `request_id`
-        (else a minted `cl-*` id) rides EVERY attempt."""
+        (else a minted `cl-*` id) rides EVERY attempt.  `headers`
+        merge OVER the client-level extra headers per attempt (e.g. a
+        per-request X-Priority on a shared authenticated client)."""
         retries = self.retries if retries is None else retries
         deadline_s = (
             self.deadline_s if deadline_s is None else deadline_s
         )
         timeout = self.timeout if timeout is None else timeout
+        # `headers` is reused below for RESPONSE headers; keep the
+        # caller's request extras under their own name.
+        per_call_headers = headers
         rid = request_id or self._mint()
         # One trace id for the whole logical request: every attempt
         # (and thus every router hop and replica it lands on) carries
@@ -327,7 +340,8 @@ class WavetpuClient:
             )
             attempt += 1
             status, payload, headers, error = self._attempt(
-                send_body, rid, att_timeout, traceparent
+                send_body, rid, att_timeout, traceparent,
+                extra_headers=per_call_headers,
             )
             # Transparent resume (preemptible long solves): a 503 from
             # a draining replica - or a 504 whose budget died mid-march

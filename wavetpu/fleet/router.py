@@ -30,7 +30,18 @@ that proxies /solve across N `wavetpu serve` replicas:
                     (Authorization: Bearer or X-Api-Key; else 401) and
                     the router stamps the mapped tenant label as
                     X-Wavetpu-Tenant, stripping any caller-supplied
-                    value.
+                    value.  The key's entry may also carry a QoS
+                    config (fleet/quota.py): a default priority class
+                    + ceiling (the router clamps and stamps
+                    X-Priority, stripping the inbound claim) and
+                    per-tenant token buckets - requests/s AND
+                    model-priced cells/s - enforced HERE, before
+                    routing; exhaustion answers 429 with Retry-After
+                    set to the measured bucket refill time.  With
+                    --proxy-token the router stamps
+                    X-Wavetpu-Proxy-Token on every forwarded request,
+                    so replicas started with the same secret accept
+                    tenant/priority headers ONLY from this router.
                     With --telemetry-dir the router writes its OWN
                     trace.jsonl (obs/tracing.py records): a
                     `router.request` span per proxied /solve with
@@ -82,6 +93,7 @@ from typing import Dict, Optional, Sequence, Tuple
 
 from wavetpu import progkey
 from wavetpu.core.flags import split_flags
+from wavetpu.fleet import quota
 from wavetpu.fleet.affinity import (
     AffinityTable,
     warm_label_from_server_timing,
@@ -99,7 +111,9 @@ _USAGE = (
     "[--host H] [--port P] [--poll-interval-s S] [--fail-threshold K] "
     "[--proxy-timeout-s S] [--max-body-bytes B] "
     "[--min-retry-budget-ms MS] [--api-keys-file FILE.json] "
-    "[--telemetry-dir DIR]"
+    "[--quota-default-rps R] [--quota-default-burst B] "
+    "[--quota-default-cells-per-s C] [--quota-default-cells-burst CB] "
+    "[--proxy-token SECRET] [--telemetry-dir DIR]"
 )
 
 # Response headers worth forwarding verbatim from replica to client
@@ -109,16 +123,18 @@ _USAGE = (
 _FORWARD_RESPONSE_HEADERS = (
     "X-Request-Id", "Server-Timing", "Retry-After", "traceparent",
 )
-# Request headers forwarded replica-ward.  X-Wavetpu-Tenant passes
-# through only on an UNauthenticated router (trusted internal callers);
-# with --api-keys-file the router strips the inbound value and stamps
-# its own from the key -> tenant map, so the label is unforgeable.
-# `traceparent` passes through verbatim on an UNtraced router (the
-# client's context still reaches the replica); a traced router replaces
-# it with a fresh per-attempt context under the same trace id.
+# Request headers forwarded replica-ward.  X-Wavetpu-Tenant and
+# X-Priority pass through only on an UNauthenticated router (trusted
+# internal callers); with --api-keys-file the router strips the inbound
+# values and stamps its own - the tenant from the key map, the class
+# defaulted + ceiling-clamped by the tenant's config - so neither label
+# is forgeable.  `traceparent` passes through verbatim on an UNtraced
+# router (the client's context still reaches the replica); a traced
+# router replaces it with a fresh per-attempt context under the same
+# trace id.
 _FORWARD_REQUEST_HEADERS = (
     "Content-Type", "X-Request-Id", "X-Deadline-Ms",
-    "X-Wavetpu-Tenant", "traceparent",
+    "X-Wavetpu-Tenant", "X-Priority", "traceparent",
 )
 
 
@@ -142,21 +158,13 @@ def _server_timing_total_ms(header: Optional[str]) -> Optional[float]:
     return None
 
 
-def load_api_keys(path: str) -> Dict[str, str]:
-    """Parse an --api-keys-file: a JSON object {API_KEY: TENANT_LABEL}.
-    Keys terminate AT the router (replicas never see them); the mapped
-    tenant label is what travels on as X-Wavetpu-Tenant."""
-    with open(path, encoding="utf-8") as f:
-        raw = json.load(f)
-    if not isinstance(raw, dict) or not raw or not all(
-        isinstance(k, str) and isinstance(v, str) and k and v
-        for k, v in raw.items()
-    ):
-        raise ValueError(
-            f"{path}: want a non-empty JSON object "
-            f'{{"API_KEY": "tenant-label", ...}}'
-        )
-    return dict(raw)
+def load_api_keys(path: str) -> Dict[str, quota.TenantConfig]:
+    """Parse an --api-keys-file into key -> TenantConfig.  Two value
+    shapes: the PR-12 plain tenant-label string (identity only), or a
+    QoS config object (tenant + priority default/ceiling + per-tenant
+    token-bucket rates) - fleet/quota.py `load_api_keys` holds the
+    schema.  Keys terminate AT the router (replicas never see them)."""
+    return quota.load_api_keys(path)
 
 
 class _ProxyConns:
@@ -227,7 +235,9 @@ class RouterState:
                  proxy_timeout: float = 120.0,
                  max_body_bytes: Optional[int] = None,
                  min_retry_budget_ms: float = 50.0,
-                 api_keys: Optional[Dict[str, str]] = None):
+                 api_keys: Optional[Dict] = None,
+                 quotas: Optional[quota.QuotaManager] = None,
+                 proxy_token: Optional[str] = None):
         self.table = table
         self.affinity = affinity
         self.proxy_timeout = proxy_timeout
@@ -237,9 +247,27 @@ class RouterState:
         # cannot finish in time - surface the last answer instead of
         # burning another replica's queue slot on doomed work.
         self.min_retry_budget_ms = min_retry_budget_ms
-        # key -> tenant label; None = unauthenticated router (the
-        # historical open mode).
-        self.api_keys = api_keys
+        # key -> TenantConfig; None = unauthenticated router (the
+        # historical open mode).  Plain-string values (the PR-12 flat
+        # map, still what tests/embedders hand build_router) are
+        # normalized to identity-only configs here.
+        self.api_keys: Optional[Dict[str, quota.TenantConfig]] = None
+        if api_keys is not None:
+            self.api_keys = {
+                k: (v if isinstance(v, quota.TenantConfig)
+                    else quota.parse_tenant_entry(k, v))
+                for k, v in api_keys.items()
+            }
+        # Authoritative per-tenant token buckets (requests/s +
+        # model-priced cells/s); default-constructed (enforcing
+        # nothing) when the caller passes None so the admit path stays
+        # branch-light.
+        self.quotas = quotas if quotas is not None \
+            else quota.QuotaManager()
+        # Shared secret stamped as X-Wavetpu-Proxy-Token on every
+        # forwarded request; replicas started with the same secret
+        # accept tenant/priority headers only when it matches.
+        self.proxy_token = proxy_token
         self.conns = _ProxyConns()
         self.started = time.time()
         self._lock = threading.Lock()
@@ -250,6 +278,7 @@ class RouterState:
         self.unparseable_total = 0     # body gave no identity (routed
         #                                anyway; the replica 400s it)
         self.auth_rejected_total = 0   # missing/unknown API key -> 401
+        self.quota_rejected_total = 0  # bucket exhausted -> 429
         self.budget_stops_total = 0    # retries refused: budget floor
         self.resume_handoffs_total = 0  # 503-with-token retried with
         #                                 the token re-injected
@@ -393,6 +422,7 @@ class RouterState:
                 "exhausted_total": self.exhausted_total,
                 "unparseable_total": self.unparseable_total,
                 "auth_rejected_total": self.auth_rejected_total,
+                "quota_rejected_total": self.quota_rejected_total,
                 "budget_stops_total": self.budget_stops_total,
                 "resume_handoffs_total": self.resume_handoffs_total,
                 "proxy_wall_ms_total": round(
@@ -403,6 +433,7 @@ class RouterState:
                 ),
                 "requests_per_tenant": dict(self.requests_per_tenant),
             }
+        snap.update(self.quotas.snapshot())
         snap["affinity"] = self.affinity.stats()
         members = self.table.summary()
         for row in members:
@@ -425,6 +456,8 @@ class RouterState:
             "wavetpu_router_exhausted_total": snap["exhausted_total"],
             "wavetpu_router_auth_rejected_total":
                 snap["auth_rejected_total"],
+            "wavetpu_router_quota_rejected_total":
+                snap["quota_rejected_total"],
             "wavetpu_router_budget_stops_total":
                 snap["budget_stops_total"],
             "wavetpu_router_resume_handoffs_total":
@@ -450,6 +483,13 @@ class RouterState:
         for tenant, n in sorted(snap["requests_per_tenant"].items()):
             own[
                 'wavetpu_router_tenant_requests_total'
+                f'{{tenant="{tenant}"}}'
+            ] = n
+        for tenant, n in sorted(
+            snap["quota_rejected_per_tenant"].items()
+        ):
+            own[
+                'wavetpu_router_tenant_quota_rejected_total'
                 f'{{tenant="{tenant}"}}'
             ] = n
         by_state: Dict[str, int] = {}
@@ -592,10 +632,14 @@ class _RouterHandler(BaseHTTPRequestHandler):
     def _affinity_key(self, raw: bytes) -> Optional[str]:
         """The request's routing identity, or None (unkeyed: malformed
         bodies are still FORWARDED - the replica owns the 400 contract;
-        the router must stay transparent to error-shape tests)."""
+        the router must stay transparent to error-shape tests).  Reuses
+        the ONE body parse _proxy_solve did (quota pricing and routing
+        identity share it)."""
         st = self.rstate
+        body = self._body_obj
         try:
-            body = json.loads(raw)
+            if body is None:
+                raise ValueError("unparseable body")
             return progkey.identity_from_body(
                 body, platform=st.platform
             ).affinity_key()
@@ -604,23 +648,29 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 st.unparseable_total += 1
             return None
 
-    def _auth_tenant(self) -> Tuple[bool, Optional[str]]:
-        """API-key termination: (authorized, tenant_label).  With no
-        --api-keys-file every request is authorized with a pass-through
-        tenant (trusted internal mode); with one, the key must be in
-        the map (Authorization: Bearer K, or X-Api-Key: K) and the
-        MAPPED label replaces whatever tenant header the caller sent -
-        a client can never self-assign a billing identity."""
+    def _auth_tenant(self) -> Tuple[
+        bool, Optional[str], Optional[quota.TenantConfig]
+    ]:
+        """API-key termination: (authorized, tenant_label, config).
+        With no --api-keys-file every request is authorized with a
+        pass-through tenant and no config (trusted internal mode); with
+        one, the key must be in the map (Authorization: Bearer K, or
+        X-Api-Key: K) and the MAPPED label replaces whatever tenant
+        header the caller sent - a client can never self-assign a
+        billing identity.  The returned TenantConfig carries the
+        tenant's quota buckets + priority default/ceiling."""
         st = self.rstate
         if st.api_keys is None:
-            return True, self.headers.get("X-Wavetpu-Tenant")
+            return True, self.headers.get("X-Wavetpu-Tenant"), None
         key = self.headers.get("X-Api-Key")
         if not key:
             auth = self.headers.get("Authorization", "") or ""
             if auth.startswith("Bearer "):
                 key = auth[len("Bearer "):].strip()
-        tenant = st.api_keys.get(key) if key else None
-        return (tenant is not None), tenant
+        cfg = st.api_keys.get(key) if key else None
+        if cfg is None:
+            return False, None, None
+        return True, cfg.tenant, cfg
 
     def _echo_headers(self, base: Optional[dict] = None) -> dict:
         """Response headers + the trace-context echo (satellite of the
@@ -637,7 +687,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
         t0 = time.monotonic()
         with st._lock:  # noqa: SLF001
             st.requests_total += 1
-        authorized, tenant = self._auth_tenant()
+        authorized, tenant, cfg = self._auth_tenant()
         if not authorized:
             with st._lock:  # noqa: SLF001
                 st.auth_rejected_total += 1
@@ -652,6 +702,50 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 st.requests_per_tenant[tenant] = (
                     st.requests_per_tenant.get(tenant, 0) + 1
                 )
+        # ONE body parse, shared by quota pricing (here) and the
+        # affinity-key derivation (_route_solve).
+        self._body_obj = None
+        try:
+            self._body_obj = json.loads(raw)
+        except (ValueError, TypeError):
+            pass
+        # Priority-class authority: on an authenticated router the
+        # effective class is the tenant's config default (when the
+        # request declares none) clamped at its ceiling - the inbound
+        # X-Priority / body claim is an INPUT to the clamp, never
+        # forwarded as-is.
+        self._priority: Optional[str] = None
+        if cfg is not None:
+            requested = self.headers.get("X-Priority")
+            if requested is None and isinstance(self._body_obj, dict):
+                requested = self._body_obj.get("priority")
+            self._priority = cfg.effective_priority(
+                requested if isinstance(requested, str) else None
+            )
+        # Authoritative per-tenant quota spend (requests/s + model-
+        # priced cells/s) BEFORE routing: an over-quota request never
+        # occupies a replica slot.  Retry-After is the measured bucket
+        # refill time for this request's cost.  On an open router
+        # (--quota-default-* without --api-keys-file) pass-through
+        # tenant labels spend the default buckets.
+        if cfg is None and tenant and st.quotas.enforces_anything:
+            cfg = quota.TenantConfig(tenant=tenant)
+        if cfg is not None:
+            ok, retry = st.quotas.admit(
+                cfg, quota.price_cells(self._body_obj)
+            )
+            if not ok:
+                with st._lock:  # noqa: SLF001
+                    st.quota_rejected_total += 1
+                self._send(429, {
+                    "status": "error",
+                    "error": (
+                        f"tenant {tenant!r} quota exhausted"
+                    ),
+                    "retriable": True,
+                    "retry_after_s": round(retry, 3),
+                }, {"Retry-After": str(max(1, int(retry + 0.5)))})
+                return
         # Distributed tracing (docs/observability.md): adopt the
         # client's W3C traceparent as the remote parent of a
         # `router.request` span (minting a fresh trace id for
@@ -710,11 +804,20 @@ class _RouterHandler(BaseHTTPRequestHandler):
         }
         fwd_headers.setdefault("Content-Type", "application/json")
         if st.api_keys is not None:
-            # The router is the tenant authority: stamp the mapped
-            # label, never the caller's claim.
+            # The router is the tenant AND class authority: stamp the
+            # mapped label and the ceiling-clamped effective class,
+            # never the caller's claims.
             fwd_headers.pop("X-Wavetpu-Tenant", None)
+            fwd_headers.pop("X-Priority", None)
             if tenant:
                 fwd_headers["X-Wavetpu-Tenant"] = tenant
+            if self._priority:
+                fwd_headers["X-Priority"] = self._priority
+        if st.proxy_token is not None:
+            # Replica-side trust: replicas started with the same
+            # --proxy-token honor tenant/priority headers only when
+            # this secret rides along.
+            fwd_headers["X-Wavetpu-Proxy-Token"] = st.proxy_token
         # Client deadline budget (X-Deadline-Ms): each attempt forwards
         # the REMAINING budget - the original minus router-side
         # queue/retry wall already burned - so a replica never marches
@@ -922,8 +1025,10 @@ def build_router(
     rng: Optional[random.Random] = None,
     start_poller: bool = True,
     min_retry_budget_ms: float = 50.0,
-    api_keys: Optional[Dict[str, str]] = None,
+    api_keys: Optional[Dict] = None,
     telemetry_dir: Optional[str] = None,
+    quotas: Optional[quota.QuotaManager] = None,
+    proxy_token: Optional[str] = None,
 ) -> Tuple[ThreadingHTTPServer, RouterState]:
     """Assemble membership + affinity + HTTP front (port 0 =
     ephemeral).  Does ONE synchronous poll before returning so the
@@ -931,7 +1036,11 @@ def build_router(
     periodic poller (start_poller) keeps it fresh.  Returned httpd is
     not yet serving - call serve_forever() (main does) or drive it
     from a thread (tests do).  `telemetry_dir` turns on the router's
-    own span tracing (DIR/trace.jsonl, rotated like a replica's)."""
+    own span tracing (DIR/trace.jsonl, rotated like a replica's).
+    `api_keys` accepts either the PR-12 flat {key: label} map or
+    {key: TenantConfig}; `quotas` carries the router-wide default
+    bucket rates (--quota-default-*), and `proxy_token` is stamped on
+    every forwarded request for replica-side tenant trust."""
     affinity = AffinityTable(rng=rng)
     table = MembershipTable(
         member_urls, fail_threshold=fail_threshold, fetch=fetch,
@@ -941,6 +1050,7 @@ def build_router(
         table, affinity, proxy_timeout=proxy_timeout,
         max_body_bytes=max_body_bytes,
         min_retry_budget_ms=min_retry_budget_ms, api_keys=api_keys,
+        quotas=quotas, proxy_token=proxy_token,
     )
     if telemetry_dir is not None:
         state.tracer = tracing.Tracer(
@@ -963,7 +1073,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             known=("member", "host", "port", "poll-interval-s",
                    "fail-threshold", "proxy-timeout-s",
                    "max-body-bytes", "min-retry-budget-ms",
-                   "api-keys-file", "telemetry-dir"),
+                   "api-keys-file", "quota-default-rps",
+                   "quota-default-burst", "quota-default-cells-per-s",
+                   "quota-default-cells-burst", "proxy-token",
+                   "telemetry-dir"),
             allow_positionals=False,
             repeatable=("member",),
         )
@@ -986,6 +1099,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             load_api_keys(flags["api-keys-file"])
             if "api-keys-file" in flags else None
         )
+        quotas = quota.QuotaManager(
+            default_rps=(
+                float(flags["quota-default-rps"])
+                if "quota-default-rps" in flags else None
+            ),
+            default_burst=(
+                float(flags["quota-default-burst"])
+                if "quota-default-burst" in flags else None
+            ),
+            default_cells_per_s=(
+                float(flags["quota-default-cells-per-s"])
+                if "quota-default-cells-per-s" in flags else None
+            ),
+            default_cells_burst=(
+                float(flags["quota-default-cells-burst"])
+                if "quota-default-cells-burst" in flags else None
+            ),
+        )
     except (ValueError, OSError) as e:
         print(f"error: {e}", file=sys.stderr)
         print(_USAGE, file=sys.stderr)
@@ -996,10 +1127,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         proxy_timeout=proxy_timeout, max_body_bytes=max_body_bytes,
         min_retry_budget_ms=min_retry_budget_ms, api_keys=api_keys,
         telemetry_dir=flags.get("telemetry-dir"),
+        quotas=quotas, proxy_token=flags.get("proxy-token"),
     )
     if api_keys is not None:
+        n_tenants = len({c.tenant for c in api_keys.values()})
+        n_quota = sum(
+            1 for c in api_keys.values()
+            if c.rps is not None or c.cells_per_s is not None
+        )
         print(f"api keys: {len(api_keys)} key(s) -> "
-              f"{len(set(api_keys.values()))} tenant(s)")
+              f"{n_tenants} tenant(s), {n_quota} with quotas")
     if state.tracer is not None:
         print(f"telemetry: router spans -> {state.tracer.path}")
     bound = httpd.server_address

@@ -2,7 +2,8 @@
 
     wavetpu loadgen generate --out TRACE.jsonl [--mix poisson]
         [--duration S] [--qps Q] [--seed N] [--n N] [--timesteps T]
-        [--pallas] [--distinct D]
+        [--pallas] [--distinct D] [--victim-frac F] [--victim-key K]
+        [--aggressor-key K] [--aggressor-mult M]
     wavetpu loadgen replay TRACE.jsonl --target URL [--target URL2 ...]
         [--mode open|closed]
         [--concurrency C] [--speed X] [--warmup W] [--timeout S]
@@ -33,6 +34,17 @@ baseline-less replay when passed explicitly - the chaos smoke's
     --max-cold-compiles N      fresh-compile cap for the replay window
                                (0 = a warm program cache must serve
                                every program - the restart drill)
+    --tenant-slo T:KEY=V       per-tenant absolute gate (repeatable);
+                               KEY is error-budget, reject-budget, or
+                               p95-budget-ms.  The isolation drill pins
+                               `--tenant-slo victim:error-budget=0`
+                               while the aggressor sheds 429s.
+
+`--mix tenants` generates the aggressor-vs-victim QoS trace: a victim
+tenant replaying the scenario mix at interactive priority interleaved
+with an aggressor flooding oversized best_effort solves
+(`--victim-frac` splits the qps; `--victim-key`/`--aggressor-key`
+stamp api_keys; `--aggressor-mult` scales the aggressor's timesteps).
 
 Exit codes: 0 pass / generated / replayed; 1 SLO violation (the
 regression gate failed); 2 usage, unreadable input, or preflight
@@ -62,12 +74,35 @@ _SLO_FLAGS = {
     "max-cold-compiles": ("max_cold_compiles", int),
 }
 
+_TENANT_SLO_KEYS = {
+    "error-budget": ("error_budget", float),
+    "reject-budget": ("reject_budget", float),
+    "p95-budget-ms": ("p95_budget_ms", float),
+}
 
-def _slo_from_flags(flags: dict) -> Dict[str, float]:
-    slo = {}
+
+def _parse_tenant_slos(values: Sequence[str]) -> Dict[str, dict]:
+    out: Dict[str, dict] = {}
+    for raw in values:
+        head, eq, val = raw.partition("=")
+        tenant, colon, key = head.partition(":")
+        if not (eq and colon and tenant) or key not in _TENANT_SLO_KEYS:
+            raise ValueError(
+                f"--tenant-slo wants TENANT:KEY=VALUE with KEY one of "
+                f"{sorted(_TENANT_SLO_KEYS)}, got {raw!r}"
+            )
+        name, conv = _TENANT_SLO_KEYS[key]
+        out.setdefault(tenant, {})[name] = conv(val)
+    return out
+
+
+def _slo_from_flags(flags: dict) -> Dict[str, object]:
+    slo: Dict[str, object] = {}
     for flag, (key, conv) in _SLO_FLAGS.items():
         if flag in flags:
             slo[key] = conv(flags[flag])
+    if flags.get("tenant-slo"):
+        slo["tenant_slos"] = _parse_tenant_slos(flags["tenant-slo"])
     return slo
 
 
@@ -82,7 +117,8 @@ def _generate(argv: Sequence[str]) -> int:
         pos, flags = _split_flags(
             argv,
             known=("out", "mix", "duration", "qps", "seed", "n",
-                   "timesteps", "pallas", "distinct"),
+                   "timesteps", "pallas", "distinct", "victim-frac",
+                   "victim-key", "aggressor-key", "aggressor-mult"),
             valueless=("pallas",),
         )
         if pos:
@@ -101,6 +137,15 @@ def _generate(argv: Sequence[str]) -> int:
         kw = {}
         if mix == "hotkey" and "distinct" in flags:
             kw["distinct"] = int(flags["distinct"])
+        if mix == "tenants":
+            if "victim-frac" in flags:
+                kw["victim_frac"] = float(flags["victim-frac"])
+            if "victim-key" in flags:
+                kw["victim_key"] = flags["victim-key"]
+            if "aggressor-key" in flags:
+                kw["aggressor_key"] = flags["aggressor-key"]
+            if "aggressor-mult" in flags:
+                kw["aggressor_mult"] = int(flags["aggressor-mult"])
         records = trace.generate(
             mix, duration, qps, scenarios=scenarios, seed=seed, **kw
         )
@@ -132,10 +177,10 @@ def _replay(argv: Sequence[str]) -> int:
             argv,
             known=("target", "mode", "concurrency", "speed", "warmup",
                    "timeout", "out", "baseline", "no-preflight",
-                   "retries", "duration")
+                   "retries", "duration", "tenant-slo")
             + tuple(_SLO_FLAGS),
             valueless=("no-preflight",),
-            repeatable=("target",),
+            repeatable=("target", "tenant-slo"),
         )
         if len(pos) != 1:
             raise ValueError("replay wants exactly one TRACE.jsonl")
@@ -196,6 +241,12 @@ def _replay(argv: Sequence[str]) -> int:
             f"429 {row['rejected_429']}, errors {row['errors']}, "
             f"p95 {row['p95_ms']}ms"
         )
+    for tenant, row in sorted((report.get("tenants") or {}).items()):
+        print(
+            f"  tenant {tenant}: {row['requests']} requests, "
+            f"ok {row['ok']}, 429 {row['rejected_429']}, "
+            f"errors {row['errors']}, p95 {row['p95_ms']}ms"
+        )
     if "out" in flags:
         with open(flags["out"], "w", encoding="utf-8") as f:
             json.dump(report, f, indent=1, sort_keys=True)
@@ -205,7 +256,7 @@ def _replay(argv: Sequence[str]) -> int:
     absolute = {
         k: v for k, v in slo.items()
         if k in ("p99_budget_ms", "error_budget", "reject_budget",
-                 "max_cold_compiles")
+                 "max_cold_compiles", "tenant_slos")
     }
     if absolute:
         # An explicitly-passed ABSOLUTE SLO gates even without a
@@ -223,7 +274,8 @@ def _replay(argv: Sequence[str]) -> int:
 def _gate(argv: Sequence[str]) -> int:
     try:
         pos, flags = _split_flags(
-            argv, known=("baseline",) + tuple(_SLO_FLAGS)
+            argv, known=("baseline", "tenant-slo") + tuple(_SLO_FLAGS),
+            repeatable=("tenant-slo",),
         )
         if len(pos) != 1:
             raise ValueError("gate wants exactly one REPORT.json")

@@ -40,6 +40,12 @@ DEFAULT_SLO = {
     "throughput_floor_pct": 50.0,  # req/s may drop this % under baseline
     "max_cold_compiles": None,    # fresh-compile cap (0 = "a warm
                                   # replica must compile nothing")
+    # Per-tenant absolute gates on the report's `tenants` breakdown:
+    # {"TENANT": {"error_budget": F, "reject_budget": F,
+    #             "p95_budget_ms": X}} - the isolation drill's "victim
+    # sees zero errors while the aggressor eats 429s" check in ONE
+    # mixed replay (--tenant-slo victim:error_budget=0).
+    "tenant_slos": None,
 }
 
 _TIMING_KEYS = ("queue", "compile", "execute", "padding")
@@ -186,6 +192,38 @@ def build_report(result, trace_path: Optional[str] = None,
             row.update(_pcts([o.latency_s * 1e3 for o in sub]))
             per_target[t] = row
 
+    # Per-tenant / per-class breakdown (QoS traces: records carrying
+    # `tenant` / `priority`).  Omitted entirely for single-tenant
+    # traces so pre-QoS reports and baselines keep their exact shape.
+    def _qos_rows(key) -> Optional[Dict[str, dict]]:
+        labels = sorted({key(o) for o in outs if key(o)})
+        if not labels:
+            return None
+        rows: Dict[str, dict] = {}
+        for label in labels:
+            sub = [o for o in outs if key(o) == label]
+            s_ok = sum(1 for o in sub if o.status == 200)
+            s_rej = sum(1 for o in sub if o.status == 429)
+            row = {
+                "requests": len(sub),
+                "ok": s_ok,
+                "rejected_429": s_rej,
+                "errors": len(sub) - s_ok - s_rej,
+                "reject_rate": round(s_rej / len(sub), 4),
+                "error_rate": round(
+                    (len(sub) - s_ok - s_rej) / len(sub), 4
+                ),
+                "retried_requests": sum(
+                    1 for o in sub if o.attempts > 1
+                ),
+            }
+            row.update(_pcts([o.latency_s * 1e3 for o in sub]))
+            rows[label] = row
+        return rows
+
+    tenants = _qos_rows(lambda o: getattr(o, "tenant", ""))
+    classes = _qos_rows(lambda o: getattr(o, "priority", ""))
+
     slowest = sorted(outs, key=lambda o: -o.latency_s)[:5]
     report = {
         "loadgen_report": True,
@@ -236,6 +274,10 @@ def build_report(result, trace_path: Optional[str] = None,
     if per_target is not None:
         report["per_target"] = per_target
         report["targets"] = list(getattr(result, "targets", []) or [])
+    if tenants is not None:
+        report["tenants"] = tenants
+    if classes is not None:
+        report["classes"] = classes
     if meta:
         report["meta"] = meta
     return report
@@ -292,6 +334,48 @@ def gate(report: dict, baseline: Optional[dict] = None,
         fail("max_cold_compiles", cold, cfg["max_cold_compiles"],
              f"{cold} fresh compile(s) during replay exceeds budget "
              f"{cfg['max_cold_compiles']} (program cache not warm)")
+    # Per-tenant gates against the QoS breakdown: the isolation drill's
+    # one-replay form (victim zero-error while the aggressor is
+    # legitimately shedding 429s).
+    if cfg["tenant_slos"]:
+        rows = report.get("tenants") or {}
+        for tenant, tslo in sorted(cfg["tenant_slos"].items()):
+            row = rows.get(tenant)
+            if row is None:
+                fail(f"tenant:{tenant}", None, tslo,
+                     f"tenant {tenant!r} has an SLO but no requests "
+                     f"in the report")
+                continue
+            unknown = set(tslo) - {
+                "error_budget", "reject_budget", "p95_budget_ms"
+            }
+            if unknown:
+                raise ValueError(
+                    f"unknown tenant SLO keys {sorted(unknown)} "
+                    f"for {tenant!r}"
+                )
+            if tslo.get("error_budget") is not None \
+                    and row["error_rate"] > tslo["error_budget"]:
+                fail(f"tenant:{tenant}:error_budget",
+                     row["error_rate"], tslo["error_budget"],
+                     f"tenant {tenant!r} error rate "
+                     f"{row['error_rate']} exceeds budget "
+                     f"{tslo['error_budget']}")
+            if tslo.get("reject_budget") is not None \
+                    and row["reject_rate"] > tslo["reject_budget"]:
+                fail(f"tenant:{tenant}:reject_budget",
+                     row["reject_rate"], tslo["reject_budget"],
+                     f"tenant {tenant!r} 429 rate "
+                     f"{row['reject_rate']} exceeds budget "
+                     f"{tslo['reject_budget']}")
+            if tslo.get("p95_budget_ms") is not None and (
+                row["p95_ms"] is None
+                or row["p95_ms"] > tslo["p95_budget_ms"]
+            ):
+                fail(f"tenant:{tenant}:p95_budget_ms",
+                     row["p95_ms"], tslo["p95_budget_ms"],
+                     f"tenant {tenant!r} p95 {row['p95_ms']} ms "
+                     f"exceeds budget {tslo['p95_budget_ms']} ms")
 
     if baseline is not None:
         base_p99 = (baseline.get("latency_ms") or {}).get("p99_ms")
@@ -352,6 +436,15 @@ def format_gate(violations: Sequence[dict], report: dict,
             f"{srv.get('disk_hits', 0)} disk hit(s), "
             f"{srv.get('warm_hits')} warm hit(s)"
         )
+    for section, singular in (("tenants", "tenant"), ("classes", "class")):
+        # QoS breakdown: one line per tenant/class so the isolation
+        # drill's victim-vs-aggressor split is visible in the gate text.
+        for label, trow in sorted((report.get(section) or {}).items()):
+            lines.append(
+                f"  {singular + ':' + label:<18} "
+                f"{trow['requests']} req, p95 {trow.get('p95_ms')!r} ms, "
+                f"429 {trow['rejected_429']}, err {trow['errors']}"
+            )
     att = report.get("attempts_total")
     req = report.get("requests")
     if att and req and att > req:

@@ -159,6 +159,8 @@ class RequestOutcome:
     target: str = ""       # which --target URL served this request
     traceparent: str = ""  # W3C context the request carried (fleet
                            # trace join handle for trace-report)
+    tenant: str = ""       # the record's tenant label (QoS traces)
+    priority: str = ""     # the record's declared priority class
 
 
 @dataclasses.dataclass
@@ -185,15 +187,32 @@ def sum_metrics(cuts: Sequence[Dict[str, float]]) -> Dict[str, float]:
     return out
 
 
+def _qos_headers(rec: dict) -> Dict[str, str]:
+    """Map a record's multi-tenant QoS fields onto request headers:
+    api_key -> X-Api-Key (the authenticated-router form), tenant ->
+    X-Wavetpu-Tenant (open-router labeling; a keyed router strips it
+    and stamps its own), priority -> X-Priority."""
+    h: Dict[str, str] = {}
+    if rec.get("api_key"):
+        h["X-Api-Key"] = str(rec["api_key"])
+    if rec.get("tenant"):
+        h["X-Wavetpu-Tenant"] = str(rec["tenant"])
+    if rec.get("priority"):
+        h["X-Priority"] = str(rec["priority"])
+    return h
+
+
 def _post_one(base_url: str, index: int, rec: dict, rid: str,
               t_sent: float, timeout: float,
               client=None) -> RequestOutcome:
+    qos = _qos_headers(rec)
     if client is not None:
         # The retrying path (`--retries`): wavetpu.client.WavetpuClient
         # absorbs transport errors / 429 / 500 / 503 with jittered
         # backoff honoring Retry-After; the SAME request id rides every
         # attempt, so the report's join handles still resolve.
-        out = client.solve(rec["body"], request_id=rid)
+        out = client.solve(rec["body"], request_id=rid,
+                           headers=qos or None)
         return RequestOutcome(
             index=index, scenario=rec.get("scenario", "?"),
             request_id=rid, status=out.status,
@@ -204,6 +223,8 @@ def _post_one(base_url: str, index: int, rec: dict, rid: str,
             error=out.error, attempts=out.attempts,
             target=base_url.rstrip("/"),
             traceparent=out.traceparent,
+            tenant=rec.get("tenant", "") or "",
+            priority=rec.get("priority", "") or "",
         )
     body = json.dumps(rec["body"]).encode()
     traceparent = format_traceparent(mint_trace_id(), mint_span_id())
@@ -213,6 +234,7 @@ def _post_one(base_url: str, index: int, rec: dict, rid: str,
             "Content-Type": "application/json",
             "X-Request-Id": rid,
             "traceparent": traceparent,
+            **qos,
         },
     )
     t0 = time.perf_counter()
@@ -236,6 +258,8 @@ def _post_one(base_url: str, index: int, rec: dict, rid: str,
         status=status, latency_s=time.perf_counter() - t0,
         t_sent=t_sent, server_timing=timing, error=err,
         target=base_url.rstrip("/"), traceparent=traceparent,
+        tenant=rec.get("tenant", "") or "",
+        priority=rec.get("priority", "") or "",
     )
 
 
@@ -457,6 +481,8 @@ def replay(
             request_id=_mint_rid(run_tag, i), status=0,
             latency_s=timeout, t_sent=0.0, error="never completed",
             target=_target(i),
+            tenant=records[i].get("tenant", "") or "",
+            priority=records[i].get("priority", "") or "",
         )
         for i, o in enumerate(outcomes)
     ]

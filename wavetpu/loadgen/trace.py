@@ -19,6 +19,13 @@ Format (JSONL, one request per line, `t`-ordered):
                 autotuner direction (ROADMAP #5: requests declare an
                 error budget instead of a scheme).  Not sent to the
                 server today.
+ * `tenant` / `api_key` / `priority` - OPTIONAL multi-tenant QoS
+                fields: the runner sends them as X-Wavetpu-Tenant /
+                X-Api-Key / X-Priority request headers (docs/fleet.md
+                "API keys", docs/serving.md "Priority classes"), and
+                the report breaks latency/429 rates down per tenant
+                and per class.  The `tenants` mix generates a seeded
+                aggressor-vs-victim two-tenant trace with them set.
 
 Generators are seeded and deterministic: the same (mix, duration, qps,
 seed) always emits the same trace, so a CI regression gate compares
@@ -37,7 +44,11 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
-MIXES = ("uniform", "poisson", "diurnal", "hotkey")
+MIXES = ("uniform", "poisson", "diurnal", "hotkey", "tenants")
+
+# The multi-tenant QoS record fields (optional per record; the runner
+# maps them onto request headers).
+QOS_FIELDS = ("tenant", "api_key", "priority")
 
 
 def scenario_label(body: dict) -> str:
@@ -109,6 +120,9 @@ def _record(t: float, tier: dict, body: Optional[dict] = None) -> dict:
     }
     if tier.get("error_budget") is not None:
         rec["error_budget"] = tier["error_budget"]
+    for f in QOS_FIELDS:
+        if tier.get(f):
+            rec[f] = tier[f]
     return rec
 
 
@@ -200,11 +214,71 @@ def gen_hotkey(duration: float, qps: float, scenarios: Sequence[dict],
     return out
 
 
+def gen_tenants(duration: float, qps: float, scenarios: Sequence[dict],
+                seed: int = 0, victim_frac: float = 0.4,
+                victim_tenant: str = "victim",
+                aggressor_tenant: str = "aggressor",
+                victim_key: Optional[str] = None,
+                aggressor_key: Optional[str] = None,
+                aggressor_mult: int = 4) -> List[dict]:
+    """The aggressor-vs-victim isolation drill: two interleaved Poisson
+    streams.  The VICTIM replays the weighted scenario mix at
+    `victim_frac` of `qps`, every request `interactive`; the AGGRESSOR
+    fires long marches (the first scenario's body with `timesteps`
+    multiplied by `aggressor_mult` - a heavier, distinct program
+    identity) at the remaining rate, every request `best_effort`.  Each
+    record carries its tenant label (and api_key when given), so a
+    replay through a quota-enforcing router shows the aggressor eating
+    429s while the victim's interactive p95 holds - the bench `qos`
+    row's and the CI QoS smoke's workload.  Deterministic in
+    (duration, qps, seed, scenarios)."""
+    rng = random.Random(seed)
+    v_qps = max(qps * victim_frac, 1e-9)
+    a_qps = max(qps - v_qps, 1e-9)
+    out: List[dict] = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(v_qps)
+        if t >= duration:
+            break
+        tier = dict(_weighted(rng, scenarios))
+        tier["name"] = f"victim-{tier['name']}"
+        tier["tenant"] = victim_tenant
+        tier["priority"] = "interactive"
+        if victim_key:
+            tier["api_key"] = victim_key
+        out.append(_record(t, tier))
+    hot = scenarios[0]
+    body = dict(hot["body"])
+    body["timesteps"] = int(
+        body.get("timesteps", 20)
+    ) * max(1, aggressor_mult)
+    agg_tier = {
+        "name": "aggressor-long",
+        "error_budget": None,
+        "tenant": aggressor_tenant,
+        "priority": "best_effort",
+    }
+    if aggressor_key:
+        agg_tier["api_key"] = aggressor_key
+    t = 0.0
+    while True:
+        t += rng.expovariate(a_qps)
+        if t >= duration:
+            break
+        out.append(_record(t, agg_tier, body))
+    if not out:
+        out.append(_record(0.0, agg_tier, body))
+    out.sort(key=lambda r: r["t"])
+    return out
+
+
 _GENERATORS = {
     "uniform": gen_uniform,
     "poisson": gen_poisson,
     "diurnal": gen_diurnal,
     "hotkey": gen_hotkey,
+    "tenants": gen_tenants,
 }
 
 
@@ -259,6 +333,15 @@ def load_scenario_trace(path: str) -> List[dict]:
                     f"{path}:{lineno}: 't' must be a number >= 0, "
                     f"got {t!r}"
                 )
+            for f in QOS_FIELDS:
+                v = rec.get(f)
+                if v is not None and (
+                    not isinstance(v, str) or not v
+                ):
+                    raise ValueError(
+                        f"{path}:{lineno}: {f!r} must be a non-empty "
+                        f"string, got {v!r}"
+                    )
             rec.setdefault("scenario", scenario_label(rec["body"]))
             out.append(rec)
     if not out:

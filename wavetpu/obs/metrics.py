@@ -22,6 +22,12 @@ Metric catalog (docs/observability.md is the user-facing copy):
   wavetpu_supervisor_watchdog_trips_total   health-check failures
   wavetpu_supervisor_step               gauge: last completed layer
 
+The serving QoS layer (serve/scheduler.py owns those instruments)
+lands its per-class/per-tenant counters - scheduled/shed/deferred per
+priority class, tenant quota and spoof rejections, the brownout rung
+gauge - in this same registry, so they ride the identical snapshot,
+/metrics render, and fleet aggregation paths as the catalog above.
+
 Roofline + device-memory instruments (obs/perf.py owns the catalog):
 `record_solve` also stamps the shared analytic cost model's verdict
 (modeled GB/s, roofline fraction) for the config that ran and samples

@@ -103,6 +103,8 @@ _USAGE = (
     "[--program-cache-dir DIR] [--program-cache-max-bytes B] "
     "[--chunk-threshold T] [--chunk-steps S] "
     "[--solve-state-dir DIR] [--solve-state-ttl-s S] "
+    "[--brownout-thresholds P1,P2,P3] [--no-brownout] "
+    "[--proxy-token SECRET] [--tenant-inflight-cap N] "
     "[--platform NAME] "
     "[--telemetry-dir DIR] [--record-trace FILE.jsonl] [--version]"
 )
@@ -115,11 +117,13 @@ _KNOWN = (
     "breaker-threshold", "breaker-cooldown-s", "no-breaker",
     "warmup", "warmup-manifest", "program-cache-dir",
     "program-cache-max-bytes", "chunk-threshold", "chunk-steps",
-    "solve-state-dir", "solve-state-ttl-s", "platform",
+    "solve-state-dir", "solve-state-ttl-s",
+    "brownout-thresholds", "no-brownout", "proxy-token",
+    "tenant-inflight-cap", "platform",
     "telemetry-dir", "record-trace", "version",
 )
 _VALUELESS = ("no-errors", "no-watchdog", "no-server-timing",
-              "no-breaker", "version")
+              "no-breaker", "no-brownout", "version")
 
 
 def _split_flags(argv: Sequence[str]) -> dict:
@@ -207,10 +211,17 @@ def parse_solve_request(body: dict, default_kernel: str = "auto"):
             raise ValueError(
                 "resume_token must be a 64-char lowercase hex string"
             )
+    # QoS class: JSON `priority` field (the X-Priority header, when
+    # trusted, wins - _handle_solve applies it after this).  Unknown
+    # values clamp to the default class rather than 400 - priority is a
+    # scheduling hint, and a router ceiling may rewrite it anyway.
+    from wavetpu.serve.scheduler import normalize_priority
+
     return SolveRequest(
         problem=problem, lane=lane, scheme=ident.scheme, path=ident.path,
         k=ident.k, dtype_name=ident.dtype,
         mesh_shape=mesh, resume_token=resume_token,
+        priority=normalize_priority(body.get("priority")),
     )
 
 
@@ -276,6 +287,13 @@ def sanitize_tenant(raw: Optional[str]) -> Optional[str]:
     return sanitize_request_id(raw)
 
 
+def format_retry_after(seconds: float) -> str:
+    """Integer delta-seconds form of a measured backoff (the only form
+    `WavetpuClient.parse_retry_after` promises to read), floored at 1 -
+    a sub-second hint rounded to 0 would tell clients to hammer."""
+    return str(max(1, int(seconds + 0.5)))
+
+
 def server_timing_header(timing: dict, total_s: float,
                          warm: Optional[str] = None) -> str:
     """RFC-style `Server-Timing` value from the scheduler's per-request
@@ -316,7 +334,8 @@ class ServerState:
                  max_body_bytes: Optional[int] = None,
                  max_lane_cells: Optional[int] = None,
                  recorder=None, server_timing: bool = True,
-                 fault_plan=None):
+                 fault_plan=None, proxy_token: Optional[str] = None,
+                 tenant_inflight_cap: Optional[int] = None):
         self.engine = engine
         self.batcher = batcher
         self.metrics = metrics
@@ -327,6 +346,21 @@ class ServerState:
         self.recorder = recorder
         self.server_timing = server_timing
         self.fault_plan = fault_plan
+        # Replica-side tenant trust (--proxy-token): with a secret set,
+        # X-Wavetpu-Tenant / X-Priority headers are honored ONLY when
+        # the request also carries the matching X-Wavetpu-Proxy-Token -
+        # i.e. it came through the router, which holds the secret.  A
+        # direct-to-replica client without it cannot impersonate a
+        # tenant or self-promote its class; the headers are IGNORED
+        # (rejection counted) and the request still serves untenanted.
+        self.proxy_token = proxy_token
+        # Defensive per-tenant concurrency cap (--tenant-inflight-cap):
+        # a backstop UNDER the router's authoritative token buckets, so
+        # one tenant cannot occupy every handler slot of a replica even
+        # if it reaches it directly.  None = off.
+        self.tenant_inflight_cap = tenant_inflight_cap
+        self._tenant_inflight: dict = {}
+        self._tenant_lock = threading.Lock()
         self.started = time.time()
         self.draining = False
         # Readiness: `warming` is True while the background --warmup
@@ -356,6 +390,28 @@ class ServerState:
         if first:
             threading.Thread(target=httpd.shutdown, daemon=True).start()
         return first
+
+    def try_acquire_tenant_slot(self, tenant: Optional[str]) -> bool:
+        """Take one in-flight slot for `tenant` (always True with the
+        cap off or no tenant label).  Pair with release_tenant_slot."""
+        if self.tenant_inflight_cap is None or not tenant:
+            return True
+        with self._tenant_lock:
+            n = self._tenant_inflight.get(tenant, 0)
+            if n >= self.tenant_inflight_cap:
+                return False
+            self._tenant_inflight[tenant] = n + 1
+            return True
+
+    def release_tenant_slot(self, tenant: Optional[str]) -> None:
+        if self.tenant_inflight_cap is None or not tenant:
+            return
+        with self._tenant_lock:
+            n = self._tenant_inflight.get(tenant, 0) - 1
+            if n <= 0:
+                self._tenant_inflight.pop(tenant, None)
+            else:
+                self._tenant_inflight[tenant] = n
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -439,6 +495,13 @@ class _Handler(BaseHTTPRequestHandler):
                 ),
                 "backend": self._backend(),
             }
+            brownout = getattr(self.state.batcher, "brownout", None)
+            if brownout is not None:
+                # The overload ladder's state, for balancers and ops:
+                # rung 0 = healthy; higher rungs shed classes
+                # (docs/robustness.md "Brownout ladder").
+                brownout.update()
+                payload["brownout"] = brownout.snapshot()
             if self.state.warmup_error is not None:
                 payload["warmup_error"] = self.state.warmup_error
             self._send(200, payload)
@@ -541,9 +604,14 @@ class _Handler(BaseHTTPRequestHandler):
             )
         code = None
         headers: dict = {}
+        # Per-tenant in-flight accounting: _handle_solve records the
+        # slot it took here; releasing in THIS finally covers every
+        # return path (including handler exceptions).
+        self._tenant_slot: Optional[str] = None
         try:
             code, payload, headers = self._handle_solve(rid)
         finally:
+            self.state.release_tenant_slot(self._tenant_slot)
             # An unexpected handler exception must not leak the open
             # span (it would poison this thread's parent stack and
             # vanish from the trace).
@@ -562,21 +630,33 @@ class _Handler(BaseHTTPRequestHandler):
             InvalidStateTokenError,
             PreemptedError,
             QuarantinedError,
+            ShedError,
             WorkerCrashError,
         )
-        from wavetpu.serve.scheduler import QueueFullError
+        from wavetpu.serve.scheduler import (
+            QueueFullError,
+            normalize_priority,
+        )
 
         st = self.state
+        queue_depth = getattr(st.batcher, "_depth", 0)
         if st.draining:
             # Connection: close because the request body is never read
             # on this path - leftover bytes on a kept-alive socket
-            # would be parsed as the next request.
+            # would be parsed as the next request.  Retry-After is the
+            # MEASURED drain estimate for what is still queued (the
+            # historical 2 s stands in when no rate has been observed).
             st.metrics.observe_response(False)
             return 503, {
                 "status": "error",
                 "error": "server draining (shutting down)",
                 "retriable": True,
-            }, {"Retry-After": "2", "Connection": "close"}
+            }, {
+                "Retry-After": format_retry_after(
+                    st.metrics.retry_after_s(queue_depth, fallback=2.0)
+                ),
+                "Connection": "close",
+            }
         t0 = time.monotonic()
         try:
             length = int(self.headers.get("Content-Length", "0") or 0)
@@ -607,11 +687,26 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             body = json.loads(self.rfile.read(length) or b"{}")
             req = parse_solve_request(body, st.default_kernel)
-            tenant = sanitize_tenant(
-                self.headers.get("X-Wavetpu-Tenant")
-            )
+            tenant_hdr = self.headers.get("X-Wavetpu-Tenant")
+            prio_hdr = self.headers.get("X-Priority")
+            if st.proxy_token is not None and (tenant_hdr or prio_hdr):
+                # Replica-side tenant trust: identity/class headers are
+                # honored only from the router (it holds --proxy-token).
+                # A direct client's claim is IGNORED - the request still
+                # serves, untenanted and at its body-declared class.
+                if self.headers.get("X-Wavetpu-Proxy-Token") \
+                        != st.proxy_token:
+                    st.metrics.observe_tenant_spoof_rejected()
+                    tenant_hdr = prio_hdr = None
+            tenant = sanitize_tenant(tenant_hdr)
             if tenant is not None:
                 req = dataclasses.replace(req, tenant=tenant)
+            if prio_hdr:
+                # The router-stamped (ceiling-clamped) class wins over
+                # the body's self-declared one.
+                req = dataclasses.replace(req, priority=normalize_priority(
+                    prio_hdr, default=req.priority
+                ))
             # Deadline contract: `X-Deadline-Ms` header (proxy-settable,
             # wins) or JSON `deadline_ms` - a RELATIVE budget in ms from
             # server receipt.  None (the historical default) disables
@@ -647,6 +742,25 @@ class _Handler(BaseHTTPRequestHandler):
             # Accepted traffic only (post-validation, post-limits): the
             # recorded trace replays cleanly instead of re-issuing junk.
             st.recorder.record(body, request_id=rid)
+        if not st.try_acquire_tenant_slot(req.tenant):
+            # Defensive per-tenant in-flight cap (--tenant-inflight-cap):
+            # the router's token buckets are the authoritative quota;
+            # this is the replica's backstop against a tenant that
+            # bypasses or outraces them.  429 like quota exhaustion,
+            # with the measured queue-drain estimate as the hint.
+            st.metrics.observe_tenant_inflight_rejected(req.tenant)
+            st.metrics.observe_response(False)
+            return 429, {
+                "status": "error",
+                "error": (
+                    f"tenant {req.tenant!r} is at its in-flight cap "
+                    f"({st.tenant_inflight_cap})"
+                ),
+                "retriable": True,
+            }, {"Retry-After": format_retry_after(
+                st.metrics.retry_after_s(queue_depth)
+            )}
+        self._tenant_slot = req.tenant
         try:
             fut = st.batcher.submit(
                 req, request_id=rid, deadline=deadline,
@@ -658,11 +772,27 @@ class _Handler(BaseHTTPRequestHandler):
             # with a Retry-After hint so a well-behaved client backs
             # off instead of hammering.  (Sub-millisecond rejections
             # stay out of the latency reservoir - they would drag p50
-            # to ~0 under overload.)
+            # to ~0 under overload.)  Retry-After is MEASURED: the
+            # queue-drain estimate from recent batch throughput, not a
+            # constant - a deep backlog says "come back later", a
+            # transient blip says "1s".
             st.metrics.observe_response(False)
             return 429, {
                 "status": "error", "error": str(e), "retriable": True,
-            }, {"Retry-After": "1"}
+            }, {"Retry-After": format_retry_after(
+                st.metrics.retry_after_s(queue_depth)
+            )}
+        except ShedError as e:
+            # Brownout ladder: queue-wait p95 over threshold and this
+            # request's class is at/below the rung being shed.  The
+            # replica is overloaded, not broken - retriable 503 whose
+            # Retry-After is the measured drain estimate the ladder
+            # computed at shed time.
+            st.metrics.observe_response(False)
+            return 503, {
+                "status": "error", "error": str(e), "retriable": True,
+                "shed_rung": e.rung,
+            }, {"Retry-After": format_retry_after(e.retry_after_s)}
         except Exception as e:
             # A closed batcher ("batcher is closed" during shutdown)
             # gets its 500 JSON, not a connection reset - the
@@ -727,10 +857,14 @@ class _Handler(BaseHTTPRequestHandler):
         except WorkerCrashError as e:
             # The scheduler worker died mid-batch and was restarted:
             # the request itself is fine - retriable 503, never a hang.
+            # Retry-After from the drain estimate: the restarted worker
+            # re-marches the requeued backlog before fresh retries land.
             st.metrics.observe_response(False)
             return 503, {
                 "status": "error", "error": str(e), "retriable": True,
-            }, {"Retry-After": "1"}
+            }, {"Retry-After": format_retry_after(
+                st.metrics.retry_after_s(queue_depth)
+            )}
         except FuturesTimeoutError:
             st.metrics.observe_response(False)
             # 504 only when the DEADLINE is what ran out: a budget
@@ -812,6 +946,10 @@ def build_server(
     chunk_steps: int = 32,
     solve_state_dir: Optional[str] = None,
     solve_state_ttl_s: float = 3600.0,
+    brownout: bool = True,
+    brownout_thresholds: Sequence[float] = (0.5, 2.0, 8.0),
+    proxy_token: Optional[str] = None,
+    tenant_inflight_cap: Optional[int] = None,
 ) -> Tuple[ThreadingHTTPServer, ServerState]:
     """Assemble engine + batcher + HTTP server (port 0 = ephemeral; the
     bound port is `httpd.server_address[1]`).  Returned httpd is not yet
@@ -835,11 +973,19 @@ def build_server(
     preemptible chunked march (serve/preempt.py; None = historical
     monolithic path only); `solve_state_dir` enables mid-flight
     checkpoints + resume tokens (shared across replicas =
-    cross-replica handoff), GC'd after `solve_state_ttl_s`."""
+    cross-replica handoff), GC'd after `solve_state_ttl_s`.
+    `brownout`/`brownout_thresholds` configure the adaptive overload
+    ladder (queue-wait p95 over the rungs sheds best_effort, then
+    batch, then defers chunk starts; --no-brownout disables);
+    `proxy_token` gates tenant/priority headers to router-stamped
+    requests only, and `tenant_inflight_cap` bounds any one tenant's
+    concurrent in-flight solves at this replica."""
     from wavetpu.obs.registry import MetricsRegistry
     from wavetpu.run import faults
     from wavetpu.serve.engine import ServeEngine
-    from wavetpu.serve.scheduler import DynamicBatcher, ServeMetrics
+    from wavetpu.serve.scheduler import (
+        BrownoutController, DynamicBatcher, ServeMetrics,
+    )
 
     registry = MetricsRegistry()
     if fault_plan is None:
@@ -860,11 +1006,15 @@ def build_server(
 
         state_store = SolveStateStore(solve_state_dir,
                                       ttl_s=solve_state_ttl_s)
+    bo = (
+        BrownoutController(thresholds=tuple(brownout_thresholds))
+        if brownout else None
+    )
     batcher = DynamicBatcher(
         engine, metrics=metrics, max_batch=max_batch, max_wait=max_wait,
         length_bucket_steps=length_bucket_steps, max_queue=max_queue,
         fault_plan=fault_plan, chunk_threshold=chunk_threshold,
-        chunk_steps=chunk_steps, state_store=state_store,
+        chunk_steps=chunk_steps, state_store=state_store, brownout=bo,
     )
     recorder = None
     if record_trace is not None:
@@ -876,7 +1026,8 @@ def build_server(
         engine, batcher, metrics, default_kernel,
         max_body_bytes=max_body_bytes, max_lane_cells=max_lane_cells,
         recorder=recorder, server_timing=server_timing,
-        fault_plan=fault_plan,
+        fault_plan=fault_plan, proxy_token=proxy_token,
+        tenant_inflight_cap=tenant_inflight_cap,
     )
     return httpd, httpd.wavetpu_state
 
@@ -955,6 +1106,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         )
         chunk_steps = int(flags.get("chunk-steps", "32"))
         solve_state_ttl_s = float(flags.get("solve-state-ttl-s", "3600"))
+        brownout_thresholds = tuple(
+            float(x)
+            for x in flags.get("brownout-thresholds", "0.5,2,8").split(",")
+        )
+        if len(brownout_thresholds) != 3:
+            raise ValueError(
+                "--brownout-thresholds wants P1,P2,P3 (three seconds "
+                "values, ascending)"
+            )
+        tenant_inflight_cap = (
+            int(flags["tenant-inflight-cap"])
+            if "tenant-inflight-cap" in flags else None
+        )
     except (ValueError, OSError) as e:
         print(f"error: {e}", file=sys.stderr)
         print(_USAGE, file=sys.stderr)
@@ -985,6 +1149,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         chunk_threshold=chunk_threshold, chunk_steps=chunk_steps,
         solve_state_dir=flags.get("solve-state-dir"),
         solve_state_ttl_s=solve_state_ttl_s,
+        brownout="no-brownout" not in flags,
+        brownout_thresholds=brownout_thresholds,
+        proxy_token=flags.get("proxy-token"),
+        tenant_inflight_cap=tenant_inflight_cap,
     )
     if state.engine.progcache is not None:
         pc = state.engine.progcache

@@ -117,14 +117,18 @@ class SolveStateStore:
 
     def put(self, identity: dict, state: Sequence, step: int,
             abs_errors: np.ndarray, rel_errors: np.ndarray,
-            origin_trace: Optional[Sequence[str]] = None) -> str:
+            origin_trace: Optional[Sequence[str]] = None,
+            priority: Optional[str] = None) -> str:
         """Checkpoint `state` (layers up to `step` marched) -> token.
 
         `origin_trace` is the originating request's (trace id, span id)
         pair; it rides in the meta blob so a resuming replica can link
-        its chunk spans back to the trace where the march began.  Load
+        its chunk spans back to the trace where the march began.
+        `priority` is the march's QoS class: a resume adopts it, so a
+        best_effort march stays best_effort however the resume request
+        is labeled (the class was clamped at original admission).  Load
         identity verification only reads `_IDENTITY_FIELDS`, so the
-        extra key never affects token acceptance."""
+        extra keys never affect token acceptance."""
         from wavetpu.io.checkpoint import _encode_field
 
         arrays = {}
@@ -139,6 +143,8 @@ class SolveStateStore:
         meta["state_tags"] = tags
         if origin_trace is not None:
             meta["origin_trace"] = [str(x) for x in origin_trace]
+        if priority is not None:
+            meta["priority"] = str(priority)
         arrays["meta"] = np.frombuffer(
             json.dumps(meta, sort_keys=True).encode("utf-8"),
             dtype=np.uint8,

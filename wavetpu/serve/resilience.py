@@ -21,6 +21,11 @@ client can tell "retry me" from "your fault" from "too late":
  * `InvalidStateTokenError` -> HTTP 422.  A `resume_token` failed
    verification (bad format, missing/corrupt/expired file, or identity
    mismatch with the request) - the client's fault, never retriable.
+ * `ShedError`              -> HTTP 503 + `Retry-After`.  The brownout
+   ladder (serve/scheduler.py BrownoutController) refused the request's
+   priority class while queue-wait p95 is over threshold;
+   `retry_after_s` is the measured queue-drain estimate, not a
+   constant.
 
 `CircuitBreaker` quarantines per program identity (the ProgramKey minus
 its batch bucket - one poisoned tier is ONE breaker however it
@@ -89,6 +94,22 @@ class InvalidStateTokenError(ValueError):
     corrupt checkpoint file (content hash mismatch), expired entry, or
     an identity that does not match the request.  Client error (422),
     never a traceback and never retriable."""
+
+
+class ShedError(RuntimeError):
+    """The brownout ladder shed this request at admission: queue-wait
+    p95 is over a rung threshold and the request's priority class is at
+    or below the rung being shed.  RETRIABLE (503 + Retry-After) - the
+    replica is overloaded, not broken.  `retry_after_s` is the MEASURED
+    queue-drain estimate (`ServeMetrics.retry_after_s`), so the client
+    backs off exactly as long as the backlog says, and `rung` names the
+    ladder step that fired (docs/robustness.md "Brownout ladder")."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0,
+                 rung: str = ""):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+        self.rung = rung
 
 
 class QuarantinedError(RuntimeError):

@@ -17,6 +17,28 @@ batches simulations the same way an LLM server batches prompts):
    cached program, watchdogs each lane; every future resolves with ITS
    lane's result (or per-lane health error) plus batch context.
 
+Multi-tenant QoS (docs/serving.md "Priority classes"): every request
+carries a `priority` class (interactive | batch | best_effort).  The
+stash is one deque PER CLASS, drained by weighted deficit round-robin
+(`CLASS_WEIGHTS` 16:4:1): each worker pass credits every backlogged
+class its weight, serves the largest deficit (ties go to the higher
+static class), and debits the winner the round's total credit - so an
+eligible interactive request takes the NEXT pass ahead of a lower-class
+chunked march's next chunk slot (the one-chunk-per-pass machinery makes
+preemption a dequeue-ordering decision), while the deficit counter
+guarantees best_effort is served within ~sum(weights)/1 passes however
+hard interactive floods (the starvation bound tests/test_qos.py pins).
+With a single backlogged class the deficits stay zeroed and scheduling
+is exactly the historical FIFO - the QoS-off fast path.
+
+`BrownoutController` is the adaptive overload ladder: when measured
+queue-wait p95 crosses its rung thresholds the batcher sheds
+best_effort admissions first, then batch, then defers NEW chunked-march
+starts - and de-escalates only after a hysteresis-gated cooldown so the
+ladder never flaps.  Shed responses are 503 + a MEASURED Retry-After
+(`ServeMetrics.retry_after_s`, the queue-drain estimate that also
+replaced the hardcoded queue-full/draining constants).
+
 `ServeMetrics` is the shared counter block /metrics renders: request and
 batch counts, occupancy, latency percentiles over a sliding reservoir,
 and aggregate Gcell/s across all served lanes.  Since the unified-
@@ -50,8 +72,30 @@ from wavetpu.serve.resilience import (
     DeadlineExceededError,
     InvalidStateTokenError,
     PreemptedError,
+    ShedError,
     WorkerCrashError,
 )
+
+# Priority classes, highest static priority first.  The order IS the
+# deficit tie-break and the brownout shed order (best_effort sheds
+# first).  CLASS_WEIGHTS drive the deficit round-robin: under a
+# two-class backlog the service ratio converges to the weight ratio,
+# and the lowest class is served at least once per ~sum(weights)
+# worker passes - the starvation bound.
+PRIORITY_CLASSES = ("interactive", "batch", "best_effort")
+CLASS_WEIGHTS = {"interactive": 16, "batch": 4, "best_effort": 1}
+DEFAULT_PRIORITY = "batch"
+
+
+def normalize_priority(value, default: str = DEFAULT_PRIORITY) -> str:
+    """Clamp any caller-supplied priority to a known class (unknown or
+    absent values land on `default`, never an error - priority is a
+    scheduling hint, not a validation surface)."""
+    if isinstance(value, str):
+        v = value.strip().lower()
+        if v in PRIORITY_CLASSES:
+            return v
+    return default
 
 
 class QueueFullError(RuntimeError):
@@ -83,6 +127,11 @@ class SolveRequest:
     # spans, per-tenant counters, and ledger lines.  Never part of the
     # program identity.
     tenant: Optional[str] = None
+    # QoS class (PRIORITY_CLASSES member; submit() normalizes unknown
+    # values to "batch").  Drives the per-class deficit round-robin and
+    # the brownout shed order - never the program identity, so classes
+    # still coalesce into one batch when their keys match.
+    priority: str = DEFAULT_PRIORITY
 
     def bucket_key(self) -> Tuple:
         """Everything the compiled program identity depends on; only
@@ -221,6 +270,56 @@ class ServeMetrics:
             "chunked long solves currently mid-march (march state "
             "held between scheduler rounds; survives worker crashes)",
         )
+        # Multi-tenant QoS (docs/serving.md "Priority classes").
+        self._class_requests = r.counter(
+            "wavetpu_serve_class_requests_total",
+            "solve requests admitted by priority class",
+            ("class",),
+        )
+        self._scheduled = r.counter(
+            "wavetpu_serve_scheduled_total",
+            "requests scheduled onto a worker pass by priority class "
+            "(deficit round-robin picks)",
+            ("class",),
+        )
+        self._shed = r.counter(
+            "wavetpu_serve_shed_total",
+            "admissions refused by the brownout ladder, by rung and "
+            "priority class (503 + measured Retry-After)",
+            ("rung", "class"),
+        )
+        self._tenant_shed = r.counter(
+            "wavetpu_serve_tenant_shed_total",
+            "brownout sheds by router-stamped tenant label",
+            ("tenant",),
+        )
+        self._brownout_rung = r.gauge(
+            "wavetpu_serve_brownout_rung",
+            "current brownout ladder rung (0 healthy, 1 shedding "
+            "best_effort, 2 shedding batch too, 3 deferring chunk "
+            "starts)",
+        )
+        self._chunk_deferred = r.counter(
+            "wavetpu_serve_chunk_starts_deferred_total",
+            "worker passes that deferred starting a NEW chunked march "
+            "because the brownout ladder is at its top rung",
+        )
+        self._tenant_inflight_rejected = r.counter(
+            "wavetpu_serve_tenant_inflight_rejected_total",
+            "requests refused by the per-tenant in-flight cap "
+            "(--tenant-inflight-cap; 429 + measured Retry-After)",
+            ("tenant",),
+        )
+        self._tenant_spoof_rejected = r.counter(
+            "wavetpu_serve_tenant_spoof_rejected_total",
+            "direct-to-replica requests whose tenant/priority headers "
+            "were IGNORED for lack of the --proxy-token secret "
+            "(request still served, untenanted)",
+        )
+        # Drain-rate estimator behind `retry_after_s`: (monotonic end
+        # time, lanes completed) per batch, guarded by the registry
+        # lock like everything else here.
+        self._drained: "deque[Tuple[float, int]]" = deque(maxlen=64)
         # Exact-percentile reservoir for the JSON snapshot's historical
         # latency_p50/p95_ms fields (the histogram above serves
         # Prometheus); guarded by the REGISTRY lock so snapshot() is one
@@ -270,6 +369,50 @@ class ServeMetrics:
         if tenant:
             self._tenant_requests.inc(tenant=tenant)
 
+    def observe_class_request(self, priority: str) -> None:
+        self._class_requests.inc(**{"class": priority})
+
+    def observe_scheduled(self, priority: str) -> None:
+        self._scheduled.inc(**{"class": priority})
+
+    def observe_shed(self, rung: str, priority: str,
+                     tenant: Optional[str] = None) -> None:
+        self._shed.inc(**{"rung": rung, "class": priority})
+        if tenant:
+            self._tenant_shed.inc(tenant=tenant)
+
+    def observe_brownout_rung(self, rung: int) -> None:
+        self._brownout_rung.set(rung)
+
+    def observe_chunk_start_deferred(self) -> None:
+        self._chunk_deferred.inc()
+
+    def observe_tenant_inflight_rejected(self, tenant: str) -> None:
+        self._tenant_inflight_rejected.inc(tenant=tenant)
+
+    def observe_tenant_spoof_rejected(self) -> None:
+        self._tenant_spoof_rejected.inc()
+
+    def retry_after_s(self, pending: int, fallback: float = 1.0) -> float:
+        """MEASURED backoff hint for 429/503 responses: how long until
+        `pending` queued lanes drain at the recently observed service
+        rate (lanes completed per second over the last minute of
+        batches), clamped to [1, 60] seconds.  `fallback` (the
+        historical constant for the call site) is returned when no
+        batch has completed recently - a cold or idle server has no
+        rate to measure, and a fixed small hint beats a wild guess."""
+        now = time.monotonic()
+        with self.registry.lock:
+            samples = [s for s in self._drained if now - s[0] <= 60.0]
+        if len(samples) < 2:
+            return fallback
+        span = now - samples[0][0]
+        lanes = sum(n for _, n in samples[1:])
+        if span <= 0.0 or lanes <= 0:
+            return fallback
+        rate = lanes / span
+        return min(60.0, max(1.0, (pending + 1) / rate))
+
     def observe_batch(self, occupancy: int, batched: bool,
                       cells: float, solve_seconds: float,
                       batch_size: Optional[int] = None,
@@ -287,6 +430,7 @@ class ServeMetrics:
             self._cells.inc(cells)
             self._solve_seconds.inc(solve_seconds)
             self._last_batch_ts.set(time.time())
+            self._drained.append((time.monotonic(), occupancy))
             for i, w in enumerate(queue_waits):
                 rid = request_ids[i] if i < len(request_ids) else None
                 self._queue_wait.observe(
@@ -375,6 +519,8 @@ class ServeMetrics:
                 "chunks_total": int(self._chunks.value()),
                 "preempted_total": int(self._preempted.total()),
                 "resumed_total": int(self._resumes.total()),
+                "shed_total": int(self._shed.total()),
+                "brownout_rung": int(self._brownout_rung.value()),
             }
 
 
@@ -440,6 +586,138 @@ class _ChunkProgress:
         self.origin_trace: Optional[List[str]] = None
 
 
+class BrownoutController:
+    """The adaptive overload ladder (docs/robustness.md "Brownout
+    ladder").  Input: queue-wait samples (submit-to-batch-formed
+    seconds) the batcher feeds at every batch formation / chunk init.
+    Output: a rung in [0, 3] recomputed from the p95 of the samples
+    seen in the last `sample_ttl_s` seconds:
+
+        rung 0  healthy            admit everything
+        rung 1  p95 >= thresholds[0]  shed best_effort admissions
+        rung 2  p95 >= thresholds[1]  shed batch admissions too
+        rung 3  p95 >= thresholds[2]  also defer NEW chunked-march
+                                      starts (in-flight marches keep
+                                      draining; interactive still
+                                      admitted at every rung)
+
+    Escalation is immediate (overload hurts NOW); de-escalation is
+    hysteresis-gated - one rung at a time, only after `cooldown_s`
+    since the last change AND with p95 back under `hysteresis` x the
+    current rung's threshold - so the ladder settles instead of
+    flapping around a threshold.  Thread-safe; `update()` is cheap
+    enough for the submit path (the p95 is recomputed at most every
+    `min_interval_s`)."""
+
+    RUNG_NAMES = ("healthy", "shed_best_effort", "shed_batch",
+                  "defer_chunk_starts")
+
+    def __init__(self, thresholds=(0.5, 2.0, 8.0), window: int = 256,
+                 min_samples: int = 8, hysteresis: float = 0.5,
+                 cooldown_s: float = 5.0, sample_ttl_s: float = 30.0,
+                 min_interval_s: float = 0.1):
+        if len(thresholds) != 3:
+            raise ValueError(
+                f"thresholds must be 3 ascending seconds, got "
+                f"{thresholds!r}"
+            )
+        t = tuple(float(x) for x in thresholds)
+        if not (0 < t[0] <= t[1] <= t[2]):
+            raise ValueError(
+                f"thresholds must be 3 ascending seconds, got "
+                f"{thresholds!r}"
+            )
+        self.thresholds = t
+        self.min_samples = min_samples
+        self.hysteresis = hysteresis
+        self.cooldown_s = cooldown_s
+        self.sample_ttl_s = sample_ttl_s
+        self.min_interval_s = min_interval_s
+        self._samples: "deque[Tuple[float, float]]" = deque(
+            maxlen=window
+        )
+        self._lock = threading.Lock()
+        self._rung = 0
+        self._last_change = 0.0
+        self._last_update = 0.0
+        self._p95 = 0.0
+
+    def observe_wait(self, seconds: float) -> None:
+        with self._lock:
+            self._samples.append((time.monotonic(), float(seconds)))
+
+    def _compute_p95(self, now: float) -> Optional[float]:
+        live = [w for t, w in self._samples
+                if now - t <= self.sample_ttl_s]
+        if len(live) < self.min_samples:
+            return None
+        return percentile_nearest_rank(sorted(live), 0.95)
+
+    def update(self) -> int:
+        """Recompute (rate-limited) and return the current rung."""
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_update < self.min_interval_s:
+                return self._rung
+            self._last_update = now
+            p95 = self._compute_p95(now)
+            self._p95 = p95 if p95 is not None else 0.0
+            if p95 is None:
+                # Not enough recent signal: decay toward healthy on
+                # the same cooldown cadence as a measured recovery.
+                desired = 0
+            else:
+                desired = 0
+                for i, th in enumerate(self.thresholds):
+                    if p95 >= th:
+                        desired = i + 1
+            if desired > self._rung:
+                self._rung = desired
+                self._last_change = now
+            elif desired < self._rung:
+                recovered = (
+                    p95 is None
+                    or p95 <= self.hysteresis
+                    * self.thresholds[self._rung - 1]
+                )
+                if recovered and now - self._last_change \
+                        >= self.cooldown_s:
+                    self._rung -= 1  # one rung at a time
+                    self._last_change = now
+            return self._rung
+
+    @property
+    def rung(self) -> int:
+        with self._lock:
+            return self._rung
+
+    def rung_name(self, rung: Optional[int] = None) -> str:
+        return self.RUNG_NAMES[self.rung if rung is None else rung]
+
+    def sheds(self, priority: str) -> bool:
+        """Does the CURRENT rung shed this class?  Interactive is never
+        shed by the ladder (quotas and the bounded queue still apply)."""
+        r = self.rung
+        if r >= 2:
+            return priority in ("batch", "best_effort")
+        if r >= 1:
+            return priority == "best_effort"
+        return False
+
+    def defers_chunk_starts(self) -> bool:
+        return self.rung >= 3
+
+    def snapshot(self) -> dict:
+        """The /healthz `brownout` block."""
+        with self._lock:
+            return {
+                "rung": self._rung,
+                "rung_name": self.RUNG_NAMES[self._rung],
+                "queue_wait_p95_s": round(self._p95, 4),
+                "thresholds_s": list(self.thresholds),
+            }
+
+
 class DynamicBatcher:
     """The request queue + single batching worker.
 
@@ -483,7 +761,8 @@ class DynamicBatcher:
                  fault_plan: Optional[faults.ServeFaultPlan] = None,
                  chunk_threshold: Optional[int] = None,
                  chunk_steps: int = 32,
-                 state_store=None):
+                 state_store=None,
+                 brownout: Optional[BrownoutController] = None):
         self.engine = engine
         self.metrics = metrics if metrics is not None else ServeMetrics()
         # Chaos harness: worker-crash / slow-batch injections fire at
@@ -530,7 +809,15 @@ class DynamicBatcher:
         self.max_queue = max_queue
         self._depth = 0
         self._q: "queue.Queue[_Item]" = queue.Queue()
-        self._pending: "deque[_Item]" = deque()
+        # The stash: one deque PER PRIORITY CLASS, drained by weighted
+        # deficit round-robin (_pick_locked).  With one backlogged
+        # class the deficits stay zero and scheduling is the historical
+        # arrival-order FIFO.
+        self._pending = {c: deque() for c in PRIORITY_CLASSES}
+        self._deficit = {c: 0.0 for c in PRIORITY_CLASSES}
+        # Adaptive overload shedding (None = ladder off: submit never
+        # sheds, chunk starts never defer).
+        self.brownout = brownout
         # Guards _pending: the worker mutates it between batches and
         # close() sweeps it after the join timeout - which can expire
         # while a drain is still executing batches, so the sweep must
@@ -628,6 +915,29 @@ class DynamicBatcher:
         `trace_context` is the serving span's (trace id, wire span id):
         chunk spans stamp the trace id and checkpoints carry it so
         resumed marches link back to the originating request."""
+        request.priority = normalize_priority(
+            getattr(request, "priority", None)
+        )
+        # Brownout ladder: overload sheds lower classes AT ADMISSION
+        # (before any queue accounting) with a measured Retry-After -
+        # a fast retriable 503, never a slow timeout.
+        if self.brownout is not None:
+            self.brownout.update()
+            self.metrics.observe_brownout_rung(self.brownout.rung)
+            if self.brownout.sheds(request.priority):
+                rung = self.brownout.rung_name()
+                self.metrics.observe_shed(
+                    rung, request.priority, request.tenant
+                )
+                raise ShedError(
+                    f"overloaded: brownout ladder at rung "
+                    f"'{rung}' is shedding {request.priority} "
+                    f"requests; retry later",
+                    retry_after_s=self.metrics.retry_after_s(
+                        self._depth
+                    ),
+                    rung=rung,
+                )
         chunked = self._chunk_mode(request)
         if chunked:
             # A unique key: chunked items never coalesce with (or get
@@ -664,6 +974,7 @@ class DynamicBatcher:
             self._q.put(item)
         self.metrics.observe_request()
         self.metrics.observe_tenant(request.tenant)
+        self.metrics.observe_class_request(request.priority)
         return item.future
 
     def close(self, timeout: float = 5.0, drain: bool = False) -> None:
@@ -692,8 +1003,11 @@ class DynamicBatcher:
         # sit out the full request timeout.  After a completed drain
         # there is nothing left here and this is a no-op.
         with self._plock:
-            leftovers = list(self._pending)
-            self._pending.clear()
+            leftovers = [
+                i for c in PRIORITY_CLASSES for i in self._pending[c]
+            ]
+            for c in PRIORITY_CLASSES:
+                self._pending[c].clear()
         while True:
             try:
                 item = self._q.get_nowait()
@@ -758,26 +1072,89 @@ class DynamicBatcher:
                 ))
         if requeue:
             with self._plock:
-                self._pending.extendleft(reversed(requeue))
+                for item in reversed(requeue):
+                    # Front of the item's CLASS queue: the march
+                    # resumes at its own class's next turn, not ahead
+                    # of higher classes.
+                    self._pending[self._class_of(item)].appendleft(item)
             for _ in requeue:
                 self.metrics.observe_resume("crash")
         self.metrics.observe_worker_restart()
 
-    def _take_pending(self, key, limit: int) -> List[_Item]:
-        taken, keep = [], deque()
+    @staticmethod
+    def _class_of(item: _Item) -> str:
+        return normalize_priority(
+            getattr(item.request, "priority", None)
+        )
+
+    def _pending_empty(self) -> bool:
         with self._plock:
-            while self._pending:
-                item = self._pending.popleft()
-                if item.key == key and len(taken) < limit:
-                    taken.append(item)
-                else:
-                    keep.append(item)
-            self._pending.extend(keep)
+            return not any(
+                self._pending[c] for c in PRIORITY_CLASSES
+            )
+
+    def _stash_locked(self, item: _Item) -> None:
+        self._pending[self._class_of(item)].append(item)
+
+    def _pick_locked(self) -> Optional[_Item]:
+        """One weighted-deficit-round-robin pick (caller holds _plock).
+
+        Each pick credits every BACKLOGGED class its weight, serves the
+        class with the largest deficit (ties break to the higher static
+        class), then debits the winner the round's total credit.  Net
+        effect: service converges to the 16:4:1 weight ratio under
+        backlog, a newly-arrived interactive request beats a lower
+        class's next turn (its 16-credit first round outbids any
+        deficit a lower class can have accrued before its own turn
+        comes), and best_effort is served at least once every
+        ~sum(weights) picks - the starvation bound.  A class's deficit
+        resets when its queue empties (classic DRR: credit never
+        banks while idle), so a SINGLE backlogged class runs at
+        deficit zero - exactly the historical FIFO, no QoS overhead."""
+        nonempty = [c for c in PRIORITY_CLASSES if self._pending[c]]
+        if not nonempty:
+            return None
+        if len(nonempty) == 1:
+            c = nonempty[0]
+            for k in PRIORITY_CLASSES:
+                self._deficit[k] = 0.0
+            return self._pending[c].popleft()
+        total = 0.0
+        for c in nonempty:
+            self._deficit[c] += CLASS_WEIGHTS[c]
+            total += CLASS_WEIGHTS[c]
+        best = max(
+            nonempty,
+            key=lambda c: (self._deficit[c],
+                           -PRIORITY_CLASSES.index(c)),
+        )
+        self._deficit[best] -= total
+        item = self._pending[best].popleft()
+        if not self._pending[best]:
+            self._deficit[best] = 0.0
+        return item
+
+    def _take_pending(self, key, limit: int) -> List[_Item]:
+        """Same-key batchmates from EVERY class queue (a matching
+        request rides along whatever its class - it is being served
+        now, which can only help it)."""
+        taken: List[_Item] = []
+        with self._plock:
+            for c in PRIORITY_CLASSES:
+                keep = deque()
+                while self._pending[c]:
+                    item = self._pending[c].popleft()
+                    if item.key == key and len(taken) < limit:
+                        taken.append(item)
+                    else:
+                        keep.append(item)
+                self._pending[c].extend(keep)
         return taken
 
     def _drain_queue(self) -> None:
-        """Move everything still in the queue onto the pending stash
-        (arrival order preserved) - the drain path's intake."""
+        """Move everything still in the queue onto the per-class stash
+        (arrival order preserved within a class) - the worker's intake
+        and the drain path's."""
         while True:
             try:
                 item = self._q.get_nowait()
@@ -785,7 +1162,7 @@ class DynamicBatcher:
                 return
             if item is not None:
                 with self._plock:
-                    self._pending.append(item)
+                    self._stash_locked(item)
 
     def _loop(self) -> None:
         while True:
@@ -793,30 +1170,67 @@ class DynamicBatcher:
                 if not self._drain:
                     return
                 self._drain_queue()
-                if not self._pending:
+                if self._pending_empty():
                     return
+            # Intake first so the pick sees EVERY arrival: this is the
+            # strict rule - an interactive request that arrived while a
+            # lower-class chunk marched is in its class queue before
+            # the next pick, and the pick serves it ahead of the
+            # march's next chunk slot.
+            self._drain_queue()
             with self._plock:
-                first = self._pending.popleft() if self._pending else None
+                first = self._pick_locked()
             if first is None:
                 item = self._q.get()
                 if item is None:
                     continue  # sentinel: loop back to the closed check
-                first = item
+                # Serve the dequeued item THIS pass (through the pick,
+                # so deficits stay consistent): re-running the closed
+                # check here could strand an item a racing close()
+                # already popped from the queue's accounting.
+                with self._plock:
+                    self._stash_locked(item)
+                    first = self._pick_locked()
             if first.chunked:
+                # Brownout top rung: defer STARTING new marches (keep
+                # the item queued at the back of its class) while
+                # in-flight marches keep draining.  Never during a
+                # drain - flushing queued work is the whole point then.
+                if (
+                    first.chunk is None
+                    and self.brownout is not None
+                    and not (self._closed and self._drain)
+                    and self.brownout.update() >= 3
+                ):
+                    self.metrics.observe_chunk_start_deferred()
+                    with self._plock:
+                        self._stash_locked(first)
+                    # Block briefly on the queue so a stash holding
+                    # only deferred starts does not spin the worker
+                    # hot; fresh arrivals wake it immediately.
+                    try:
+                        nxt = self._q.get(timeout=0.05)
+                    except queue.Empty:
+                        continue
+                    if nxt is not None:
+                        with self._plock:
+                            self._stash_locked(nxt)
+                    continue
                 # One chunk per pass: the march yields the worker back
                 # between chunks so short/high-priority traffic
                 # interleaves instead of queueing behind a monolithic
                 # long solve.
+                self.metrics.observe_scheduled(self._class_of(first))
                 self._inflight = [first]
                 finished = self._chunk_round(first)
                 self._inflight = []
                 if not finished:
                     # Fresh arrivals (still in the queue) go ahead of
                     # the long solve's next chunk; the item itself goes
-                    # to the back of the stash.
+                    # to the back of its class's stash.
                     self._drain_queue()
                     with self._plock:
-                        self._pending.append(first)
+                        self._stash_locked(first)
                 continue
             batch = [first]
             batch += self._take_pending(
@@ -843,10 +1257,12 @@ class DynamicBatcher:
                     batch.append(nxt)
                 else:
                     with self._plock:
-                        self._pending.append(nxt)
+                        self._stash_locked(nxt)
             # Supervisor bookkeeping: these items live only in this
             # local list now; if _execute crashes past its engine try,
             # _worker_main fails them retriable instead of stranding.
+            for item in batch:
+                self.metrics.observe_scheduled(self._class_of(item))
             self._inflight = batch
             self._execute(batch)
             self._inflight = []
@@ -857,6 +1273,10 @@ class DynamicBatcher:
         # the bounded queue's accounting as they enter the engine.
         t_formed = time.monotonic()
         waits = [max(0.0, t_formed - item.enqueued) for item in batch]
+        if self.brownout is not None:
+            # The ladder's input signal: queue wait at batch formation.
+            for w in waits:
+                self.brownout.observe_wait(w)
         self._dec_depth(len(batch))
         # Deadline shedding: an item whose budget already expired in
         # queue is dropped HERE (504 with queue attribution), before any
@@ -1002,6 +1422,7 @@ class DynamicBatcher:
                 cp.runner.state_to_numpy(cp.state),
                 cp.step, cp.abs, cp.rel,
                 origin_trace=cp.origin_trace,
+                priority=item.request.priority,
             )
         except Exception:
             return None
@@ -1014,6 +1435,8 @@ class DynamicBatcher:
         req = item.request
         now = time.monotonic()
         wait = max(0.0, now - item.enqueued)
+        if self.brownout is not None:
+            self.brownout.observe_wait(wait)
         self._dec_depth(1)
         if item.deadline is not None and now >= item.deadline:
             self.metrics.observe_deadline_expired()
@@ -1076,6 +1499,15 @@ class DynamicBatcher:
                     cp.origin_trace = list(origin)
                 elif item.trace_context is not None:
                     cp.origin_trace = list(item.trace_context)
+                # The march keeps the class it was ADMITTED at: the
+                # checkpoint's priority (clamped by the router when the
+                # march began) wins over whatever label the resume
+                # request carries - a preempted best_effort solve
+                # cannot relabel itself interactive via its token.
+                if "priority" in meta:
+                    req.priority = normalize_priority(
+                        meta.get("priority"), default=req.priority
+                    )
                 self.metrics.observe_resume("token")
             else:
                 state, abs2, rel2, boot_c, boot_s = cp.runner.bootstrap()
