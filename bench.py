@@ -960,6 +960,163 @@ def _fleet_row(interp):
         return {"error": "failed; see stderr"}
 
 
+def _ha_row(interp):
+    """The control plane priced + the failover gap measured.  Arm 1:
+    a warmed replica behind a one-member router replayed store-OFF,
+    then the identical replay behind a router flushing its control
+    plane to --control-plane-dir - the p95 delta is the rent of
+    durability (WAL appends on the flush cadence), bar <= 2%.  Arm 2:
+    active + standby routers over one shared store dir; the active is
+    killed cold (no lease release) and a multi-endpoint WavetpuClient
+    holding BOTH router URLs times the gap from the kill to the first
+    solve the promoted standby answers - the zero-downtime failover
+    claim as a number (bounded by about one lease TTL + one solve)."""
+    import os
+    import shutil
+    import tempfile
+    import threading
+    import time
+    import traceback
+
+    from wavetpu.client import WavetpuClient
+    from wavetpu.fleet.router import build_router
+    from wavetpu.loadgen import report as lg_report
+    from wavetpu.loadgen import runner, trace
+    from wavetpu.serve.api import build_server
+
+    n, steps, kernel = (8, 6, "roll") if interp else (64, 20, "auto")
+    scenarios = trace.default_scenarios(n=n, timesteps=steps)
+    records = trace.generate(
+        "poisson", duration=3.0, qps=6.0, scenarios=scenarios, seed=29
+    )
+
+    def serve():
+        httpd, state = build_server(
+            port=0, max_wait=0.02, default_kernel=kernel,
+            interpret=interp,
+        )
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd, state, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    def front(member_urls, **kw):
+        rh, rs = build_router(member_urls, poll_interval_s=0.5, **kw)
+        threading.Thread(target=rh.serve_forever, daemon=True).start()
+        return rh, rs, f"http://127.0.0.1:{rh.server_address[1]}"
+
+    def stop_front(rh, rs, release=True):
+        if rs.ha is not None:
+            rs.ha.stop(release=release)
+        rs.stop_poller()
+        rh.shutdown()
+        rh.server_close()
+
+    def run(base, warmup):
+        res = runner.replay(base, records, mode="closed",
+                            concurrency=4, warmup=warmup, timeout=1800)
+        return lg_report.build_report(res, target=base)
+
+    cp_dir = tempfile.mkdtemp(prefix="wavetpu-bench-ha-")
+    try:
+        h1, s1, u1 = serve()
+        try:
+            run(u1, warmup=len(scenarios))  # warm every tier + bucket
+            # Arm 1: store OFF vs ON through the same warmed replica.
+            rh, rs, ru = front([u1])
+            try:
+                rep_off = run(ru, warmup=0)
+            finally:
+                stop_front(rh, rs)
+            rh, rs, ru = front(
+                [u1],
+                control_plane_dir=os.path.join(cp_dir, "arm1"),
+                store_flush_interval_s=0.1,
+            )
+            try:
+                rep_on = run(ru, warmup=0)
+            finally:
+                stop_front(rh, rs)
+            # Arm 2: active + standby over one dir, active killed cold.
+            shared = os.path.join(cp_dir, "arm2")
+            ra_h, ra_s, _ = front(
+                [u1], control_plane_dir=shared, lease_ttl_s=0.6,
+                store_flush_interval_s=0.05,
+            )
+            rb_h, rb_s, _ = front(
+                [u1], control_plane_dir=shared, lease_ttl_s=0.6,
+                store_flush_interval_s=0.05,
+            )
+            fail = {}
+            try:
+                # Let both settle into their roles, then address the
+                # pair the way a real client does: both URLs at once.
+                deadline = time.monotonic() + 10.0
+                while (ra_s.role == rb_s.role
+                       and time.monotonic() < deadline):
+                    time.sleep(0.05)
+                pairs = [(ra_h, ra_s), (rb_h, rb_s)]
+                cli = WavetpuClient(
+                    [f"http://127.0.0.1:{h.server_address[1]}"
+                     for h, _ in pairs],
+                    retries=20, timeout=120,
+                )
+                body = {"N": n, "timesteps": steps}
+                pre = cli.solve(body)
+                act = next(p for p in pairs if p[1].role == "active")
+                sur = next(p for p in pairs if p is not act)
+                t_kill = time.monotonic()
+                act[0].shutdown()
+                act[0].server_close()
+                act[1].ha.stop(release=False)  # crash: lease left held
+                act[1].stop_poller()
+                post = cli.solve(body)
+                fail = {
+                    "failover_gap_s": round(
+                        time.monotonic() - t_kill, 3),
+                    "failover_ok": bool(pre.ok and post.ok),
+                    "endpoint_failovers": cli.endpoint_failovers,
+                    "survivor_takeovers": int(
+                        sur[1].ha.takeovers_total),
+                }
+            finally:
+                for h, s in (pairs if 'pairs' in locals() else ()):
+                    try:
+                        stop_front(h, s)
+                    except Exception:
+                        pass
+        finally:
+            h1.shutdown()
+            s1.batcher.close()
+            h1.server_close()
+        p95_off = rep_off["latency_ms"]["p95_ms"]
+        p95_on = rep_on["latency_ms"]["p95_ms"]
+        row = {
+            "requests": rep_on["requests"],
+            "store_off_p95_ms": p95_off,
+            "store_on_p95_ms": p95_on,
+            "store_overhead_p95_pct": round(
+                100.0 * (p95_on - p95_off) / p95_off, 2
+            ) if p95_off else None,
+            "store_on_error_rate": rep_on["error_rate"],
+            "policy": "best_of_1",
+            "config": (
+                f"poisson mix {len(records)} reqs, closed loop c=4, "
+                f"N={n}/{steps} kernel={kernel}; arm1 = warmed "
+                f"router[1 member] store-off vs --control-plane-dir "
+                f"(flush 0.1s), bar <= 2% p95; arm2 = active+standby "
+                f"over one dir (ttl 0.6s), active killed cold, gap = "
+                f"kill -> first solve via the promoted standby"
+            ),
+        }
+        row.update(fail)
+        return row
+    except Exception:
+        print("ha sub-benchmark failed:", file=sys.stderr)
+        traceback.print_exc()
+        return {"error": "failed; see stderr"}
+    finally:
+        shutil.rmtree(cp_dir, ignore_errors=True)
+
+
 def _dtrace_row(interp):
     """Distributed tracing priced end to end: the fleet arm-1 replay
     (warmed single replica behind a one-member router) with W3C
@@ -1622,6 +1779,10 @@ def main() -> int:
     # <= 10% p95 bar) and ProgramKey-affinity hit rate + per-replica
     # spread over a two-member fleet.
     subs["fleet"] = _fleet_row(interp)
+    # Router HA: control-plane store rent (store-on vs store-off warmed
+    # replay, <= 2% p95 bar) + the measured active-kill failover gap
+    # through a multi-endpoint client.
+    subs["ha"] = _ha_row(interp)
     # Distributed tracing: router+replica replay traced on both tiers
     # vs untraced (<= 2% p95 bar) + the merged cross-process join proof.
     subs["dtrace"] = _dtrace_row(interp)
@@ -1727,6 +1888,11 @@ def main() -> int:
         "fleet_occupancy_spread": subs["fleet"].get(
             "occupancy_spread"
         ),
+        "ha_store_overhead_p95_pct": subs["ha"].get(
+            "store_overhead_p95_pct"
+        ),
+        "ha_failover_gap_s": subs["ha"].get("failover_gap_s"),
+        "ha_failover_ok": subs["ha"].get("failover_ok"),
         "dtrace_overhead_p95_pct": subs["dtrace"].get(
             "dtrace_overhead_p95_pct"
         ),

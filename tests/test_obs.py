@@ -537,8 +537,20 @@ class TestMetricCatalogLint:
             r"(wavetpu_[a-z0-9_]+)['\"]"
         )
         # The router renders its own samples as text, not through the
-        # registry - catch every full-name literal there too.
-        router_lit = re.compile(r"['\"](wavetpu_router_[a-z0-9_]+)")
+        # registry - catch every full-name literal there too.  The
+        # control-plane store and HA coordinator do the same with
+        # wavetpu_store_* / wavetpu_fleet_* samples.
+        literal_res = {
+            "router.py": re.compile(
+                r"['\"](wavetpu_router_[a-z0-9_]+)"
+            ),
+            "store.py": re.compile(
+                r"['\"](wavetpu_store_[a-z0-9_]+)"
+            ),
+            "ha.py": re.compile(
+                r"['\"](wavetpu_fleet_[a-z0-9_]+)"
+            ),
+        }
         names = set()
         for dirpath, _dirs, files in os.walk(root):
             if "__pycache__" in dirpath:
@@ -549,9 +561,10 @@ class TestMetricCatalogLint:
                 src = open(os.path.join(dirpath, fn),
                            encoding="utf-8").read()
                 names.update(ctor.findall(src))
-                if fn == "router.py":
+                lit = literal_res.get(fn)
+                if lit is not None:
                     names.update(
-                        m for m in router_lit.findall(src)
+                        m for m in lit.findall(src)
                         if not m.endswith("_")
                     )
         return names

@@ -30,6 +30,15 @@ This client is the matching half:
    march from the last completed chunk instead of restarting; a
    504-with-token is even retried (while budget remains) because each
    attempt makes forward progress.
+ * **Multi-endpoint failover**: `base_url` may be a LIST of router
+   URLs (an HA pair/fleet - docs/fleet.md "Control plane & router
+   HA").  A transport failure or a standby-503 (`"standby": true`,
+   the not-the-lease-holder answer) ROTATES the client to the next
+   endpoint for the retry - counted as `endpoint_failovers` - instead
+   of backing off against a dead or deferring router.  The retry
+   budget, deadline, request-id, resume-token, and traceparent
+   semantics are unchanged: a failover retry is just a retry that
+   lands somewhere more useful.
 
 `solve()` returns a `SolveOutcome` (it does not raise on HTTP errors -
 the status/error fields are the result; a load generator must count
@@ -57,7 +66,8 @@ import random
 import threading
 import time
 import urllib.parse
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, \
+    Union
 
 from wavetpu.obs.tracing import format_traceparent, mint_span_id, \
     mint_trace_id
@@ -138,7 +148,7 @@ class WavetpuClient:
 
     def __init__(
         self,
-        base_url: str,
+        base_url: Union[str, Sequence[str]],
         retries: int = 2,
         timeout: float = 120.0,
         deadline_s: Optional[float] = None,
@@ -154,15 +164,30 @@ class WavetpuClient:
             raise ValueError(
                 f"deadline_s must be > 0, got {deadline_s}"
             )
-        self.base_url = base_url.rstrip("/")
-        parts = urllib.parse.urlsplit(self.base_url)
-        if parts.scheme != "http" or not parts.hostname:
-            raise ValueError(
-                f"base_url must be http://host[:port], got {base_url!r}"
+        # One endpoint is the historical single-server client; several
+        # are an HA router set the client fails over across.  All
+        # threads share ONE current-endpoint cursor: once one thread
+        # discovers an endpoint is dead/standby, nobody else should
+        # have to rediscover it.
+        urls = [base_url] if isinstance(base_url, str) else list(base_url)
+        if not urls:
+            raise ValueError("base_url needs at least one endpoint")
+        self.endpoints: List[str] = []
+        self._parsed: List[Tuple[str, int, str]] = []
+        for u in urls:
+            u = str(u).rstrip("/")
+            parts = urllib.parse.urlsplit(u)
+            if parts.scheme != "http" or not parts.hostname:
+                raise ValueError(
+                    f"base_url must be http://host[:port], got {u!r}"
+                )
+            self.endpoints.append(u)
+            self._parsed.append(
+                (parts.hostname, parts.port or 80,
+                 parts.path.rstrip("/"))
             )
-        self._host = parts.hostname
-        self._port = parts.port or 80
-        self._path_prefix = parts.path.rstrip("/")
+        self._cur = 0
+        self.endpoint_failovers = 0
         self.retries = retries
         self.timeout = timeout
         self.deadline_s = deadline_s
@@ -186,19 +211,44 @@ class WavetpuClient:
         self._n += 1
         return f"cl-{self._tag}-{self._n}"
 
+    @property
+    def base_url(self) -> str:
+        """The endpoint requests currently target (the only endpoint
+        for a single-URL client) - kept as an attribute-shaped property
+        so existing callers and reports read the live value."""
+        return self.endpoints[self._cur]
+
+    def _rotate(self, from_idx: int) -> None:
+        """Advance the shared endpoint cursor past `from_idx` - the
+        endpoint that just failed.  A no-op if another thread already
+        moved it (their failover counts once, ours doesn't double) or
+        if there is nowhere else to go."""
+        if len(self.endpoints) < 2:
+            return
+        with self._stats_lock:
+            if self._cur != from_idx:
+                return
+            self._cur = (from_idx + 1) % len(self.endpoints)
+            self.endpoint_failovers += 1
+
     # ---- transport (keep-alive) ----
 
-    def _conn(self, timeout: float) -> Tuple[http.client.HTTPConnection,
-                                             bool]:
-        """This thread's persistent connection (created on first use),
-        with the socket timeout refreshed for this request.  Returns
-        (conn, reused) - reused=True when the socket is already up."""
-        conn = getattr(self._local, "conn", None)
+    def _conn(self, idx: int, timeout: float
+              ) -> Tuple[http.client.HTTPConnection, bool]:
+        """This thread's persistent connection TO ENDPOINT `idx`
+        (created on first use), with the socket timeout refreshed for
+        this request.  Returns (conn, reused) - reused=True when the
+        socket is already up."""
+        conns = getattr(self._local, "conns", None)
+        if conns is None:
+            conns = {}
+            self._local.conns = conns
+        conn = conns.get(idx)
         if conn is None:
-            conn = http.client.HTTPConnection(
-                self._host, self._port, timeout=timeout
-            )
-            self._local.conn = conn
+            host, port, _prefix = self._parsed[idx]
+            conn = http.client.HTTPConnection(host, port,
+                                              timeout=timeout)
+            conns[idx] = conn
             with self._stats_lock:
                 self.connections_opened += 1
         reused = conn.sock is not None
@@ -207,52 +257,62 @@ class WavetpuClient:
             conn.sock.settimeout(timeout)
         return conn, reused
 
-    def _reset_conn(self, orderly: bool = False) -> None:
-        """Close and forget this thread's connection (next request
-        reconnects).  `orderly` = the server announced `Connection:
-        close`; anything else counts as a reset."""
-        conn = getattr(self._local, "conn", None)
+    def _reset_conn(self, idx: int, orderly: bool = False) -> None:
+        """Close and forget this thread's connection to endpoint `idx`
+        (next request there reconnects).  `orderly` = the server
+        announced `Connection: close`; anything else counts as a
+        reset."""
+        conns = getattr(self._local, "conns", None)
+        conn = conns.get(idx) if conns else None
         if conn is None:
             return
         try:
             conn.close()
         except Exception:
             pass
-        self._local.conn = None
+        conns.pop(idx, None)
         if not orderly:
             with self._stats_lock:
                 self.connection_resets += 1
 
     def close(self) -> None:
-        """Retire the CALLING thread's persistent connection (other
+        """Retire the CALLING thread's persistent connections (other
         threads' sockets close when their conns are garbage-collected)."""
-        self._reset_conn(orderly=True)
+        conns = getattr(self._local, "conns", None)
+        for idx in list(conns) if conns else ():
+            self._reset_conn(idx, orderly=True)
 
     def _request(self, method: str, path: str, data: Optional[bytes],
-                 headers: Dict[str, str], timeout: float
+                 headers: Dict[str, str], timeout: float,
+                 idx: Optional[int] = None
                  ) -> Tuple[int, bytes, Dict[str, str]]:
-        """One HTTP exchange on the thread's kept-alive connection.
-        Raises OSError/http.client errors on transport failure (after
-        resetting the connection so the next attempt reconnects)."""
-        conn, reused = self._conn(timeout)
+        """One HTTP exchange on the thread's kept-alive connection to
+        endpoint `idx` (default: the current endpoint).  Raises
+        OSError/http.client errors on transport failure (after
+        resetting that connection so the next attempt reconnects)."""
+        if idx is None:
+            idx = self._cur
+        conn, reused = self._conn(idx, timeout)
+        prefix = self._parsed[idx][2]
         try:
-            conn.request(method, self._path_prefix + path, body=data,
+            conn.request(method, prefix + path, body=data,
                          headers=headers)
             resp = conn.getresponse()
             raw = resp.read()
         except Exception:
-            self._reset_conn()
+            self._reset_conn(idx)
             raise
         if reused:
             with self._stats_lock:
                 self.requests_on_reused_connection += 1
         if resp.will_close:
-            self._reset_conn(orderly=True)
+            self._reset_conn(idx, orderly=True)
         return resp.status, raw, dict(resp.headers)
 
     def _attempt(self, body: dict, rid: str, timeout: float,
                  traceparent: str = "",
-                 extra_headers: Optional[Dict[str, str]] = None):
+                 extra_headers: Optional[Dict[str, str]] = None,
+                 idx: Optional[int] = None):
         """One POST /solve: (status, payload, headers, error)."""
         headers = dict(self.headers)
         if extra_headers:
@@ -264,7 +324,7 @@ class WavetpuClient:
         try:
             status, raw, headers = self._request(
                 "POST", "/solve", json.dumps(body).encode(), headers,
-                timeout,
+                timeout, idx=idx,
             )
         except (OSError, http.client.HTTPException) as e:
             return 0, None, {}, f"{type(e).__name__}: {e}" if str(e) \
@@ -339,9 +399,10 @@ class WavetpuClient:
                 else min(timeout, remaining + 0.25)
             )
             attempt += 1
+            endpoint_idx = self._cur
             status, payload, headers, error = self._attempt(
                 send_body, rid, att_timeout, traceparent,
-                extra_headers=per_call_headers,
+                extra_headers=per_call_headers, idx=endpoint_idx,
             )
             # Transparent resume (preemptible long solves): a 503 from
             # a draining replica - or a 504 whose budget died mid-march
@@ -366,7 +427,21 @@ class WavetpuClient:
             )
             if status == 200 or not retriable or attempt > retries:
                 break
-            delay = parse_retry_after(headers)
+            # Multi-endpoint failover: a dead socket (status 0) or a
+            # standby router's not-the-lease-holder 503 means THIS
+            # endpoint is the problem, not this request - rotate the
+            # shared cursor so the retry (and every other thread) lands
+            # on the next router.  A rotated retry ignores Retry-After:
+            # that header described the endpoint being left.
+            standby = (
+                status == 503 and isinstance(payload, dict)
+                and payload.get("standby") is True
+            )
+            rotated = False
+            if (status == 0 or standby) and len(self.endpoints) > 1:
+                self._rotate(endpoint_idx)
+                rotated = True
+            delay = None if rotated else parse_retry_after(headers)
             if delay is None:
                 delay = min(
                     self.backoff_max_s,

@@ -105,6 +105,49 @@ class AffinityTable:
         with self._lock:
             return len(self._holders)
 
+    # ---- persistence (fleet/store.py) ----
+
+    def export_state(self) -> dict:
+        """Durable view: the holder table plus the decision counters
+        (restored so affinity hit rates stay monotonic across a router
+        restart/failover)."""
+        with self._lock:
+            return {
+                "holders": {
+                    ak: sorted(urls)
+                    for ak, urls in self._holders.items()
+                },
+                "hits": self.hits,
+                "rerouted": self.rerouted,
+                "cold": self.cold,
+                "unkeyed": self.unkeyed,
+            }
+
+    def restore_state(self, data: dict) -> int:
+        """UNION-merge persisted holders into the live table (the
+        successor may already have fresher poll data - never discard
+        it) and max-merge the counters.  Returns keys adopted."""
+        if not isinstance(data, dict):
+            return 0
+        holders = data.get("holders")
+        adopted = 0
+        with self._lock:
+            if isinstance(holders, dict):
+                for ak, urls in holders.items():
+                    if not isinstance(urls, (list, tuple)):
+                        continue
+                    self._holders.setdefault(ak, set()).update(
+                        str(u).rstrip("/") for u in urls
+                    )
+                    adopted += 1
+            for field in ("hits", "rerouted", "cold", "unkeyed"):
+                try:
+                    v = int(data.get(field) or 0)
+                except (TypeError, ValueError):
+                    continue
+                setattr(self, field, max(getattr(self, field), v))
+        return adopted
+
     def stats(self) -> dict:
         with self._lock:
             routed = self.hits + self.rerouted

@@ -238,6 +238,97 @@ class MembershipTable:
         with self._lock:
             return [m.summary() for m in self._members.values()]
 
+    # ---- persistence (fleet/store.py) ----
+
+    def export_state(self) -> dict:
+        """Durable membership view: every record's state-machine
+        position, frozen/last Prometheus snapshot, join baseline, and
+        last health block (the per-member brownout rung rides in
+        there).  Retired aliases export too - they carry the frozen
+        counters that keep fleet deltas monotonic across deploys."""
+        with self._lock:
+            return {
+                url: {
+                    "state": m.state,
+                    "base_url": m.base_url,
+                    "joined_unix": round(m.joined_unix, 3),
+                    "prom": dict(m.prom),
+                    "prom_baseline": dict(m.prom_baseline),
+                    "baseline_pending": m.baseline_pending,
+                    "health": m.health if isinstance(m.health, dict)
+                    else {},
+                    "warm_keys": m.warm_key_count,
+                }
+                for url, m in self._members.items()
+            }
+
+    def restore_state(self, data: dict) -> int:
+        """Adopt a predecessor's membership view.  LEFT records (and
+        retired aliases) restore FROZEN - their snapshots keep
+        aggregating, which is what makes fleet /metrics monotonic
+        across a router restart.  Live records merge conservatively:
+        unknown urls join as JOINING (the next poll decides
+        routability - restoring UP outright could route to a corpse),
+        known urls adopt the persisted baseline/prom only where the
+        live record has none yet (a fresher poll always wins).
+        Malformed entries are skipped.  Returns records adopted."""
+        if not isinstance(data, dict):
+            return 0
+        adopted = 0
+        for key, rec in data.items():
+            if not isinstance(rec, dict):
+                continue
+            state = rec.get("state")
+            prom = rec.get("prom")
+            prom = prom if isinstance(prom, dict) else {}
+            baseline = rec.get("prom_baseline")
+            baseline = baseline if isinstance(baseline, dict) else {}
+            with self._lock:
+                m = self._members.get(key)
+                if m is None:
+                    m = Member(rec.get("base_url") or key)
+                    if state in (LEFT, LEAVING):
+                        # Frozen history: never polled again.
+                        m.state = LEFT
+                        m.prom = {
+                            k: float(v) for k, v in prom.items()
+                            if isinstance(v, (int, float))
+                        }
+                        m.prom_baseline = {
+                            k: float(v) for k, v in baseline.items()
+                            if isinstance(v, (int, float))
+                        }
+                    else:
+                        m.state = JOINING
+                        m.prom_baseline = {
+                            k: float(v) for k, v in baseline.items()
+                            if isinstance(v, (int, float))
+                        }
+                        m.baseline_pending = bool(
+                            rec.get("baseline_pending")
+                        )
+                        if isinstance(rec.get("health"), dict):
+                            m.health = rec["health"]
+                    self._members[key] = m
+                    adopted += 1
+                    continue
+                # Known url: fill only the gaps a fresh process has.
+                if not m.prom_baseline and baseline:
+                    m.prom_baseline = {
+                        k: float(v) for k, v in baseline.items()
+                        if isinstance(v, (int, float))
+                    }
+                    m.baseline_pending = False
+                if not m.prom and prom and m.state == LEFT:
+                    m.prom = {
+                        k: float(v) for k, v in prom.items()
+                        if isinstance(v, (int, float))
+                    }
+                if not m.health and isinstance(rec.get("health"), dict):
+                    m.health = rec["health"]
+                adopted += 1
+        return adopted
+
     # ---- the poll ----
 
     def poll_member(self, m: Member) -> None:

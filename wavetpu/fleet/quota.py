@@ -137,6 +137,34 @@ class TokenBucket:
             self._refill_locked(now)
             return self._tokens
 
+    # ---- persistence (fleet/store.py) ----
+
+    def export_state(self) -> dict:
+        """Durable view of this bucket.  The internal clock is
+        monotonic (meaningless across processes), so the export pairs
+        the refreshed level with a UNIX stamp; restore refills for the
+        wall time that elapsed in between - a restarted router neither
+        reopens a drained bucket nor double-charges the downtime."""
+        return {
+            "rate": self.rate,
+            "burst": self.burst,
+            "tokens": round(self.tokens(), 6),
+            "unix": round(time.time(), 6),
+        }
+
+    @classmethod
+    def restore(cls, data: dict) -> "TokenBucket":
+        """A bucket rebuilt from `export_state` output, refilled for
+        the wall time since export.  ValueError/KeyError on a
+        malformed record (callers treat that as a counted miss)."""
+        b = cls(float(data["rate"]), float(data["burst"]))
+        elapsed = max(0.0, time.time() - float(data["unix"]))
+        b._tokens = min(
+            b.burst, float(data["tokens"]) + elapsed * b.rate
+        )
+        b._t = time.monotonic()
+        return b
+
 
 def price_cells(body: Optional[dict]) -> float:
     """Model-priced cell volume of a /solve body: geometric cell
@@ -248,6 +276,73 @@ class QuotaManager:
                 "quota_rejected_per_tenant":
                     dict(self.rejected_per_tenant),
             }
+
+    def levels(self) -> Dict[str, dict]:
+        """Live per-tenant bucket levels (the /metrics `quota_buckets`
+        block - what the failover-parity drill compares)."""
+        with self._lock:
+            tenants = set(self._rps) | set(self._cells)
+            out: Dict[str, dict] = {}
+            for t in sorted(tenants):
+                row: Dict[str, float] = {}
+                if t in self._rps:
+                    row["rps_tokens"] = round(self._rps[t].tokens(), 4)
+                if t in self._cells:
+                    row["cells_tokens"] = round(
+                        self._cells[t].tokens(), 4
+                    )
+                out[t] = row
+            return out
+
+    # ---- persistence (fleet/store.py) ----
+
+    def export_state(self) -> dict:
+        """Everything a successor router needs to RESUME enforcement:
+        each tenant's bucket levels (with rate/burst/unix, so restore
+        can refill for downtime) plus the rejection counters."""
+        with self._lock:
+            return {
+                "rps": {
+                    t: b.export_state() for t, b in self._rps.items()
+                },
+                "cells": {
+                    t: b.export_state() for t, b in self._cells.items()
+                },
+                "rejected_per_tenant": dict(self.rejected_per_tenant),
+            }
+
+    def restore_state(self, data: dict) -> int:
+        """Adopt persisted bucket levels (malformed per-bucket records
+        are skipped - a corrupt entry costs ONE tenant one fresh
+        bucket, never the restore).  Rejection counters restore as a
+        max-merge so they stay monotonic.  Returns buckets adopted."""
+        if not isinstance(data, dict):
+            return 0
+        adopted = 0
+        for field, pool in (("rps", self._rps), ("cells", self._cells)):
+            entries = data.get(field)
+            if not isinstance(entries, dict):
+                continue
+            for tenant, rec in entries.items():
+                try:
+                    bucket = TokenBucket.restore(rec)
+                except (KeyError, TypeError, ValueError):
+                    continue
+                with self._lock:
+                    pool[tenant] = bucket
+                adopted += 1
+        rejected = data.get("rejected_per_tenant")
+        if isinstance(rejected, dict):
+            with self._lock:
+                for tenant, n in rejected.items():
+                    try:
+                        n = int(n)
+                    except (TypeError, ValueError):
+                        continue
+                    self.rejected_per_tenant[tenant] = max(
+                        self.rejected_per_tenant.get(tenant, 0), n
+                    )
+        return adopted
 
 
 def parse_tenant_entry(key: str, value) -> TenantConfig:

@@ -160,6 +160,21 @@ def roll(router_url: str, old_url: str, new_url: str,
     """The deploy sequence (module docstring).  Returns an exit code;
     fails SAFE - the predecessor is only drained AFTER the successor is
     ready and routed."""
+    # HA guard: admin mutations against a STANDBY router land in state
+    # the next promotion overwrites from the control-plane store - the
+    # join would silently vanish.  Fail before touching anything.
+    try:
+        router_health = _get_json(router_url.rstrip("/") + "/healthz")
+    except (OSError, ValueError, urllib.error.URLError) as e:
+        log(f"roll: FAILED - cannot reach router {router_url}: {e}",
+            file=sys.stderr)
+        return 1
+    if router_health.get("role") == "standby":
+        log(f"roll: FAILED - {router_url} is a STANDBY router (not the "
+            f"lease holder); a join/leave there would be overwritten "
+            f"on promotion.  Point --router at the active.",
+            file=sys.stderr)
+        return 1
     proc = None
     if spawn_argv:
         argv = list(spawn_argv)

@@ -93,12 +93,14 @@ from typing import Dict, Optional, Sequence, Tuple
 
 from wavetpu import progkey
 from wavetpu.core.flags import split_flags
+from wavetpu.fleet import ha as fleet_ha
 from wavetpu.fleet import quota
 from wavetpu.fleet.affinity import (
     AffinityTable,
     warm_label_from_server_timing,
 )
 from wavetpu.fleet.membership import LEFT, MembershipTable
+from wavetpu.fleet.store import ControlPlaneStore
 from wavetpu.obs import tracing
 from wavetpu.obs.telemetry import (
     DEFAULT_MAX_BYTES,
@@ -113,7 +115,9 @@ _USAGE = (
     "[--min-retry-budget-ms MS] [--api-keys-file FILE.json] "
     "[--quota-default-rps R] [--quota-default-burst B] "
     "[--quota-default-cells-per-s C] [--quota-default-cells-burst CB] "
-    "[--proxy-token SECRET] [--telemetry-dir DIR]"
+    "[--proxy-token SECRET] [--telemetry-dir DIR] "
+    "[--control-plane-dir DIR] [--lease-ttl-s S] "
+    "[--store-flush-interval-s S]"
 )
 
 # Response headers worth forwarding verbatim from replica to client
@@ -295,8 +299,106 @@ class RouterState:
         self.tracer: Optional[tracing.Tracer] = None
         self.proxied_per_member: Dict[str, int] = {}
         self.requests_per_tenant: Dict[str, int] = {}
+        # Control plane + HA (--control-plane-dir; both None without
+        # it - the historical standalone-active router, bit-for-bit).
+        self.store: Optional[ControlPlaneStore] = None
+        self.ha: Optional[fleet_ha.HACoordinator] = None
+        # Router-tier chaos plan (WAVETPU_FAULT router-*/store-* specs;
+        # run/faults.py router_plan_from_env).  Shared with the store
+        # and lease so count= budgets span the whole process.
+        self.fault_plan = None
+        self.standby_rejected_total = 0  # /solve answered standby-503
         self._poll_stop = threading.Event()
         self._poller: Optional[threading.Thread] = None
+
+    # ---- HA role ----
+
+    @property
+    def role(self) -> str:
+        """`active` (serving /solve) or `standby` (503s retriably until
+        the lease is ours).  A router without a control plane is always
+        active - there is nobody to defer to."""
+        return fleet_ha.ACTIVE if self.ha is None else self.ha.role
+
+    # ---- control-plane persistence (fleet/store.py sections) ----
+
+    def export_state(self) -> dict:
+        """The full durable section map the HA flusher persists."""
+        with self._lock:
+            counters = {
+                "requests_total": self.requests_total,
+                "retried_requests": self.retried_requests,
+                "retries_total": self.retries_total,
+                "exhausted_total": self.exhausted_total,
+                "unparseable_total": self.unparseable_total,
+                "auth_rejected_total": self.auth_rejected_total,
+                "quota_rejected_total": self.quota_rejected_total,
+                "budget_stops_total": self.budget_stops_total,
+                "resume_handoffs_total": self.resume_handoffs_total,
+                "standby_rejected_total": self.standby_rejected_total,
+                "proxy_wall_ms_total": round(
+                    self.proxy_wall_ms_total, 3
+                ),
+                "upstream_wall_ms_total": round(
+                    self.upstream_wall_ms_total, 3
+                ),
+                "proxied_per_member": dict(self.proxied_per_member),
+                "requests_per_tenant": dict(self.requests_per_tenant),
+            }
+        return {
+            "quota": self.quotas.export_state(),
+            "affinity": self.affinity.export_state(),
+            "membership": self.table.export_state(),
+            "router_counters": counters,
+        }
+
+    def restore_state(self, state: dict) -> None:
+        """Adopt a predecessor's persisted state (boot with a store, or
+        a standby's promotion).  Counters max-merge so the router-own
+        /metrics samples stay monotonic across the restart; quota
+        levels restore refilled for downtime; membership restores
+        frozen snapshots + baselines; affinity union-merges."""
+        if not isinstance(state, dict):
+            return
+        self.quotas.restore_state(state.get("quota") or {})
+        self.affinity.restore_state(state.get("affinity") or {})
+        self.table.restore_state(state.get("membership") or {})
+        counters = state.get("router_counters")
+        if not isinstance(counters, dict):
+            return
+        with self._lock:
+            for field in (
+                "requests_total", "retried_requests", "retries_total",
+                "exhausted_total", "unparseable_total",
+                "auth_rejected_total", "quota_rejected_total",
+                "budget_stops_total", "resume_handoffs_total",
+                "standby_rejected_total",
+            ):
+                try:
+                    v = int(counters.get(field) or 0)
+                except (TypeError, ValueError):
+                    continue
+                setattr(self, field, max(getattr(self, field), v))
+            for field in ("proxy_wall_ms_total",
+                          "upstream_wall_ms_total"):
+                try:
+                    v = float(counters.get(field) or 0.0)
+                except (TypeError, ValueError):
+                    continue
+                setattr(self, field, max(getattr(self, field), v))
+            for field, pool in (
+                ("proxied_per_member", self.proxied_per_member),
+                ("requests_per_tenant", self.requests_per_tenant),
+            ):
+                persisted = counters.get(field)
+                if not isinstance(persisted, dict):
+                    continue
+                for k, n in persisted.items():
+                    try:
+                        n = int(n)
+                    except (TypeError, ValueError):
+                        continue
+                    pool[k] = max(pool.get(k, 0), n)
 
     # ---- load signal for power-of-two-choices ----
 
@@ -425,6 +527,7 @@ class RouterState:
                 "quota_rejected_total": self.quota_rejected_total,
                 "budget_stops_total": self.budget_stops_total,
                 "resume_handoffs_total": self.resume_handoffs_total,
+                "standby_rejected_total": self.standby_rejected_total,
                 "proxy_wall_ms_total": round(
                     self.proxy_wall_ms_total, 3
                 ),
@@ -434,6 +537,16 @@ class RouterState:
                 "requests_per_tenant": dict(self.requests_per_tenant),
             }
         snap.update(self.quotas.snapshot())
+        # Live bucket levels: what the failover-parity drill compares
+        # between the pre-kill active and the promoted standby.
+        snap["quota_buckets"] = self.quotas.levels()
+        snap["role"] = self.role
+        if self.ha is not None:
+            snap["ha"] = self.ha.snapshot()
+        if self.store is not None:
+            snap["store"] = self.store.snapshot_counters()
+        if self.fault_plan is not None:
+            snap["fault_plan"] = self.fault_plan.snapshot()
         snap["affinity"] = self.affinity.stats()
         members = self.table.summary()
         for row in members:
@@ -497,6 +610,19 @@ class RouterState:
             by_state[row["state"]] = by_state.get(row["state"], 0) + 1
         for state, n in sorted(by_state.items()):
             own[f'wavetpu_router_members{{state="{state}"}}'] = n
+        own["wavetpu_router_standby_rejected_total"] = snap[
+            "standby_rejected_total"
+        ]
+        if self.store is not None:
+            own.update(self.store.prom_samples())
+        if self.ha is not None:
+            own.update(self.ha.prom_samples())
+        if self.fault_plan is not None:
+            for inj in self.fault_plan.snapshot():
+                own[
+                    'wavetpu_router_fault_injections_total'
+                    f'{{kind="{inj["kind"]}"}}'
+                ] = inj["fired"]
         lines = [f"{k} {float(v)}" for k, v in sorted(agg.items())]
         lines += [f"{k} {float(v)}" for k, v in sorted(own.items())]
         return "\n".join(lines) + "\n"
@@ -538,17 +664,24 @@ class _RouterHandler(BaseHTTPRequestHandler):
         if self.path == "/healthz":
             members = st.table.summary()
             up = sum(1 for m in members if m["state"] == "up")
-            self._send(200, {
+            payload = {
                 "status": "ok",
                 "router": True,
                 # Preflight-compatible readiness: route here iff at
-                # least one member can take traffic.
-                "ready": up > 0,
+                # least one member can take traffic AND this router
+                # holds the lease (a standby tells load balancers and
+                # loadgen preflights NOT to point measured traffic at
+                # it; the multi-endpoint client finds it on rotation).
+                "ready": up > 0 and st.role == fleet_ha.ACTIVE,
                 "draining": False,
+                "role": st.role,
                 "uptime_seconds": round(time.time() - st.started, 3),
                 "members_up": up,
                 "members": members,
-            })
+            }
+            if st.ha is not None:
+                payload["ha"] = st.ha.snapshot()
+            self._send(200, payload)
         elif self.path == "/metrics":
             accept = self.headers.get("Accept", "") or ""
             wants_text = (
@@ -687,6 +820,30 @@ class _RouterHandler(BaseHTTPRequestHandler):
         t0 = time.monotonic()
         with st._lock:  # noqa: SLF001
             st.requests_total += 1
+        if st.role != fleet_ha.ACTIVE:
+            # A standby must not admit (that would double every quota)
+            # or proxy (split-brain routing).  The 503 is retriable and
+            # carries `standby: true` so a multi-endpoint WavetpuClient
+            # rotates to the active immediately instead of backing off
+            # against this endpoint.
+            with st._lock:  # noqa: SLF001
+                st.standby_rejected_total += 1
+            self._send(503, {
+                "status": "error",
+                "error": "standby router (not the lease holder)",
+                "retriable": True,
+                "standby": True,
+            }, {"Retry-After": "1"})
+            return
+        if st.fault_plan is not None and st.fault_plan.fire(
+                "router-crash") is not None:
+            # The chaos drill's dead-active: a REAL SIGKILL of this
+            # process, mid-request - no flush, no lease release, no
+            # response.  The standby must take over within one TTL and
+            # the client must see only a transport error it absorbs.
+            import signal as _signal
+
+            os.kill(os.getpid(), _signal.SIGKILL)
         authorized, tenant, cfg = self._auth_tenant()
         if not authorized:
             with st._lock:  # noqa: SLF001
@@ -1029,6 +1186,11 @@ def build_router(
     telemetry_dir: Optional[str] = None,
     quotas: Optional[quota.QuotaManager] = None,
     proxy_token: Optional[str] = None,
+    control_plane_dir: Optional[str] = None,
+    lease_ttl_s: float = 2.0,
+    store_flush_interval_s: float = 0.5,
+    ha_owner: Optional[str] = None,
+    start_ha: bool = True,
 ) -> Tuple[ThreadingHTTPServer, RouterState]:
     """Assemble membership + affinity + HTTP front (port 0 =
     ephemeral).  Does ONE synchronous poll before returning so the
@@ -1040,7 +1202,20 @@ def build_router(
     `api_keys` accepts either the PR-12 flat {key: label} map or
     {key: TenantConfig}; `quotas` carries the router-wide default
     bucket rates (--quota-default-*), and `proxy_token` is stamped on
-    every forwarded request for replica-side tenant trust."""
+    every forwarded request for replica-side tenant trust.
+
+    `control_plane_dir` turns on the durable control plane + HA
+    (fleet/store.py, fleet/ha.py): the router elects through the dir's
+    single-writer lease (first election is SYNCHRONOUS - a lone router
+    boots straight to active with persisted quota/membership/counter
+    state restored, before serving a request; a second router over the
+    same dir boots standby and answers retriable standby-503s until
+    the lease frees).  `ha_owner` names this router in the lease
+    (default host:port#pid); `start_ha=False` leaves the coordinator
+    un-started for tests that drive ticks by hand."""
+    from wavetpu.run.faults import router_plan_from_env
+
+    fault_plan = router_plan_from_env()
     affinity = AffinityTable(rng=rng)
     table = MembershipTable(
         member_urls, fail_threshold=fail_threshold, fetch=fetch,
@@ -1052,6 +1227,7 @@ def build_router(
         min_retry_budget_ms=min_retry_budget_ms, api_keys=api_keys,
         quotas=quotas, proxy_token=proxy_token,
     )
+    state.fault_plan = fault_plan
     if telemetry_dir is not None:
         state.tracer = tracing.Tracer(
             os.path.join(telemetry_dir, TRACE_FILENAME),
@@ -1060,6 +1236,24 @@ def build_router(
     table.poll_once()
     httpd = ThreadingHTTPServer((host, port), _RouterHandler)
     httpd.wavetpu_router = state
+    if control_plane_dir is not None:
+        state.store = ControlPlaneStore(
+            control_plane_dir, fault_plan=fault_plan
+        )
+        bound = httpd.server_address
+        owner = ha_owner or f"{bound[0]}:{bound[1]}#{os.getpid()}"
+        lease = fleet_ha.LeaseManager(
+            control_plane_dir, owner, ttl_s=lease_ttl_s,
+            fault_plan=fault_plan,
+        )
+        state.ha = fleet_ha.HACoordinator(
+            state.store, lease,
+            export_state=state.export_state,
+            restore_state=state.restore_state,
+            flush_interval_s=store_flush_interval_s,
+        )
+        if start_ha:
+            state.ha.start()
     if start_poller:
         state.start_poller(poll_interval_s)
     return httpd, state
@@ -1076,7 +1270,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    "api-keys-file", "quota-default-rps",
                    "quota-default-burst", "quota-default-cells-per-s",
                    "quota-default-cells-burst", "proxy-token",
-                   "telemetry-dir"),
+                   "telemetry-dir", "control-plane-dir",
+                   "lease-ttl-s", "store-flush-interval-s"),
             allow_positionals=False,
             repeatable=("member",),
         )
@@ -1121,6 +1316,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(f"error: {e}", file=sys.stderr)
         print(_USAGE, file=sys.stderr)
         return 2
+    try:
+        lease_ttl_s = float(flags.get("lease-ttl-s", "2"))
+        store_flush_interval_s = float(
+            flags.get("store-flush-interval-s", "0.5")
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        print(_USAGE, file=sys.stderr)
+        return 2
     httpd, state = build_router(
         members, host=host, port=port,
         poll_interval_s=poll_interval_s, fail_threshold=fail_threshold,
@@ -1128,6 +1332,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         min_retry_budget_ms=min_retry_budget_ms, api_keys=api_keys,
         telemetry_dir=flags.get("telemetry-dir"),
         quotas=quotas, proxy_token=flags.get("proxy-token"),
+        control_plane_dir=flags.get("control-plane-dir"),
+        lease_ttl_s=lease_ttl_s,
+        store_flush_interval_s=store_flush_interval_s,
     )
     if api_keys is not None:
         n_tenants = len({c.tenant for c in api_keys.values()})
@@ -1139,6 +1346,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               f"{n_tenants} tenant(s), {n_quota} with quotas")
     if state.tracer is not None:
         print(f"telemetry: router spans -> {state.tracer.path}")
+    if state.ha is not None:
+        print(
+            f"control plane: {flags['control-plane-dir']} "
+            f"(role {state.role}, lease ttl {lease_ttl_s:g}s, "
+            f"flush every {store_flush_interval_s:g}s)"
+        )
     bound = httpd.server_address
     up = len(state.table.routable_urls())
     print(
@@ -1160,6 +1373,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         httpd.serve_forever()
     finally:
         state.stop_poller()
+        if state.ha is not None:
+            # Orderly exit: final flush + lease release so a standby
+            # promotes immediately instead of waiting out the TTL.
+            state.ha.stop(release=True)
         httpd.server_close()
         if state.tracer is not None:
             state.tracer.close()

@@ -7,7 +7,7 @@
     wavetpu loadgen replay TRACE.jsonl --target URL [--target URL2 ...]
         [--mode open|closed]
         [--concurrency C] [--speed X] [--warmup W] [--timeout S]
-        [--retries N] [--duration SECONDS]
+        [--retries N] [--duration SECONDS] [--failover]
         [--out REPORT.json] [--no-preflight]
         [--baseline OLD.json] [SLO flags]
     wavetpu loadgen gate REPORT.json --baseline OLD.json [SLO flags]
@@ -22,6 +22,13 @@ server-side metric deltas are summed across all targets.
 attempts - the chaos-drill client); `--duration S` is SOAK mode: loop
 the trace until the wall-clock budget elapses, reported as replay-
 window deltas like any run.
+
+`--failover` (requires `--retries` >= 1) flips multi-target from
+fan-out to HA: every `--target` joins ONE multi-endpoint client that
+rotates off a dead or standby router on retry (the router-failover
+drill).  Preflight passes if ANY target is ready, and a target whose
+/metrics cannot be scraped (the killed active) is dropped from the
+bracketing cuts; the report carries `endpoint_failovers`.
 
 SLO flags (gate + replay-with-baseline; the ABSOLUTE ones also gate a
 baseline-less replay when passed explicitly - the chaos smoke's
@@ -177,9 +184,9 @@ def _replay(argv: Sequence[str]) -> int:
             argv,
             known=("target", "mode", "concurrency", "speed", "warmup",
                    "timeout", "out", "baseline", "no-preflight",
-                   "retries", "duration", "tenant-slo")
+                   "retries", "duration", "tenant-slo", "failover")
             + tuple(_SLO_FLAGS),
-            valueless=("no-preflight",),
+            valueless=("no-preflight", "failover"),
             repeatable=("target", "tenant-slo"),
         )
         if len(pos) != 1:
@@ -208,6 +215,7 @@ def _replay(argv: Sequence[str]) -> int:
             concurrency=concurrency, speed=speed, warmup=warmup,
             timeout=timeout, skip_preflight="no-preflight" in flags,
             retries=retries, duration=duration,
+            failover="failover" in flags,
         )
     except runner.PreflightError as e:
         print(f"error: preflight failed: {e}", file=sys.stderr)
@@ -234,6 +242,11 @@ def _replay(argv: Sequence[str]) -> int:
             f"retries: {report['retried_requests']} of "
             f"{report['requests']} requests needed retries "
             f"({report['attempts_total']} attempts total)"
+        )
+    if report.get("failover"):
+        print(
+            f"failover: {report['endpoint_failovers']} endpoint "
+            f"rotation(s) across {len(targets)} router(s)"
         )
     for t, row in sorted((report.get("per_target") or {}).items()):
         print(

@@ -274,6 +274,13 @@ def build_report(result, trace_path: Optional[str] = None,
     if per_target is not None:
         report["per_target"] = per_target
         report["targets"] = list(getattr(result, "targets", []) or [])
+    if getattr(result, "failover", False):
+        # HA replay: how many times the shared client rotated off a
+        # dead or standby endpoint (0 on an uneventful run).
+        report["failover"] = True
+        report["endpoint_failovers"] = int(
+            getattr(result, "endpoint_failovers", 0)
+        )
     if tenants is not None:
         report["tenants"] = tenants
     if classes is not None:

@@ -174,6 +174,8 @@ class ReplayResult:
     concurrency: int
     speed: float
     targets: List[str] = dataclasses.field(default_factory=list)
+    failover: bool = False             # --failover: one HA client
+    endpoint_failovers: int = 0        # times the client rotated
 
 
 def sum_metrics(cuts: Sequence[Dict[str, float]]) -> Dict[str, float]:
@@ -303,6 +305,7 @@ def replay(
     skip_preflight: bool = False,
     retries: int = 0,
     duration: Optional[float] = None,
+    failover: bool = False,
 ) -> ReplayResult:
     """Drive `records` at `base_url`; returns outcomes + the /metrics
     cuts bracketing the measured phase.  `warmup` > 0 first serves up
@@ -324,7 +327,17 @@ def replay(
     tier at EVERY target (one replica warm is not the fleet warm); the
     bracketing /metrics cuts are summed sample-wise across targets so
     the report's delta math sees the fleet as one server.  Outcomes
-    carry `target` for the per-replica breakdown."""
+    carry `target` for the per-replica breakdown.
+
+    `failover=True` flips the multi-target semantics from fan-out to
+    HA: ALL targets become ONE multi-endpoint `WavetpuClient` (requires
+    `retries` >= 1 - rotation happens on retry), so requests follow the
+    client's endpoint cursor to whichever router is active and rotate
+    away from a dead/standby one.  Preflight passes if ANY target is
+    ready (a standby answers ready=false by design), warmup warms each
+    tier once through the shared client, and a target whose /metrics
+    cannot be scraped (e.g. the killed active) is dropped from the
+    bracketing cuts instead of aborting the report."""
     if mode not in ("open", "closed"):
         raise ValueError(f"mode must be open|closed, got {mode!r}")
     if concurrency < 1:
@@ -335,6 +348,12 @@ def replay(
         raise ValueError(f"retries must be >= 0, got {retries}")
     if duration is not None and duration <= 0:
         raise ValueError(f"duration must be > 0, got {duration}")
+    if failover and retries < 1:
+        raise ValueError(
+            "failover mode needs retries >= 1 (the client rotates "
+            "endpoints on retry; with no retry budget a dead router "
+            "is a client-visible error)"
+        )
     if isinstance(base_url, str):
         targets = [base_url.rstrip("/")]
     else:
@@ -345,23 +364,66 @@ def replay(
     if not records:
         raise ValueError("empty trace")
     if not skip_preflight:
-        for t in targets:
-            preflight(t)
+        if failover:
+            # An HA set is healthy when ANYONE is ready - the standby
+            # answers ready=false (not the lease holder) by design.
+            errs: List[str] = []
+            for t in targets:
+                try:
+                    preflight(t)
+                    break
+                except PreflightError as e:
+                    errs.append(str(e))
+            else:
+                raise PreflightError(
+                    "no ready endpoint in the HA set: "
+                    + "; ".join(errs)
+                )
+        else:
+            for t in targets:
+                preflight(t)
     if run_tag is None:
         # Unique enough across replays against one server; hex keeps it
         # inside the server's sanitized request-id alphabet.
         run_tag = f"{int(time.time() * 1e3) & 0xFFFFFFFF:x}"
     clients: Dict[str, object] = {}
+    shared = None
     if retries > 0:
         from wavetpu.client import WavetpuClient
 
-        clients = {
-            t: WavetpuClient(t, retries=retries, timeout=timeout)
-            for t in targets
-        }
+        if failover:
+            # ONE client over the whole HA set: its endpoint cursor is
+            # the failover state, shared by every replay thread.
+            shared = WavetpuClient(targets, retries=retries,
+                                   timeout=timeout)
+            clients = {t: shared for t in targets}
+        else:
+            clients = {
+                t: WavetpuClient(t, retries=retries, timeout=timeout)
+                for t in targets
+            }
 
     def _target(i: int) -> str:
+        if shared is not None:
+            # Label outcomes with the endpoint the HA client currently
+            # points at (best-effort: a mid-request rotation lands on
+            # the next one).
+            return shared.base_url
         return targets[i % len(targets)]
+
+    def _scrape_all() -> Dict[str, float]:
+        cuts = []
+        for t in targets:
+            try:
+                cuts.append(scrape_metrics(t))
+            except (OSError, ValueError, urllib.error.URLError):
+                # In an HA drill the killed active cannot be scraped;
+                # its counters live on in the survivors' store-restored
+                # state.  Outside failover mode a dead target is a
+                # configuration error worth dying on.
+                if not failover:
+                    raise
+        return sum_metrics(cuts)
 
     warmup_outcomes: List[RequestOutcome] = []
     if warmup > 0:
@@ -372,7 +434,11 @@ def replay(
             if tier in seen or len(seen) >= warmup:
                 continue
             seen.add(tier)
-            for t in targets:
+            # Failover mode warms through the shared client (whichever
+            # router is active proxies to the fleet); fan-out mode
+            # warms every target - one replica warm is not the fleet
+            # warm.
+            for t in ([_target(0)] if failover else targets):
                 warmup_outcomes.append(_post_one(
                     t, wi, rec, _mint_rid(run_tag + "w", wi), 0.0,
                     timeout, clients.get(t),
@@ -382,7 +448,7 @@ def replay(
     if duration is not None and mode == "open":
         records = extend_for_duration(records, duration, speed)
 
-    metrics_before = sum_metrics([scrape_metrics(t) for t in targets])
+    metrics_before = _scrape_all()
     t_start = time.perf_counter()
 
     if duration is not None and mode == "closed":
@@ -422,11 +488,13 @@ def replay(
         return ReplayResult(
             outcomes=done, warmup_outcomes=warmup_outcomes,
             metrics_before=metrics_before,
-            metrics_after=sum_metrics(
-                [scrape_metrics(t) for t in targets]
-            ),
+            metrics_after=_scrape_all(),
             wall_seconds=time.perf_counter() - t_start, mode=mode,
             concurrency=concurrency, speed=speed, targets=targets,
+            failover=failover,
+            endpoint_failovers=(
+                shared.endpoint_failovers if shared is not None else 0
+            ),
         )
 
     outcomes: List[Optional[RequestOutcome]] = [None] * len(records)
@@ -474,7 +542,7 @@ def replay(
             th.join(timeout * len(records) + 30.0)
 
     wall = time.perf_counter() - t_start
-    metrics_after = sum_metrics([scrape_metrics(t) for t in targets])
+    metrics_after = _scrape_all()
     done = [
         o if o is not None else RequestOutcome(
             index=i, scenario=records[i].get("scenario", "?"),
@@ -490,5 +558,8 @@ def replay(
         outcomes=done, warmup_outcomes=warmup_outcomes,
         metrics_before=metrics_before, metrics_after=metrics_after,
         wall_seconds=wall, mode=mode, concurrency=concurrency,
-        speed=speed, targets=targets,
+        speed=speed, targets=targets, failover=failover,
+        endpoint_failovers=(
+            shared.endpoint_failovers if shared is not None else 0
+        ),
     )

@@ -180,15 +180,19 @@ def hook_from_env(env: Optional[dict] = None):
     "preempt:STEP" returns the matching chunk hook (None when unset).
     Lets subprocess/CLI tests drill the watchdog-halt (exit 4) and
     kill-and-resume (exit 3) paths without timing races.  `serve-*`
-    specs (the serve-path plan, possibly ';'-combined with a run-side
-    spec) are ignored here - they belong to `serve_plan_from_env`."""
+    specs (the serve-path plan) and `router-*`/`store-*` specs (the
+    router-tier plan, `router_plan_from_env`) are ignored here - a
+    router chaos env leaking into a `wavetpu run` subprocess must not
+    crash the run."""
     env = os.environ if env is None else env
     spec = env.get(ENV_FAULT)
     if not spec:
         return None
     run_specs = [
         part.strip() for part in spec.split(";")
-        if part.strip() and not part.strip().startswith("serve-")
+        if part.strip() and not part.strip().startswith(
+            ("serve-",) + _ROUTER_PREFIXES
+        )
     ]
     if not run_specs:
         return None
@@ -218,6 +222,13 @@ SERVE_KINDS = ("compile-fail", "execute-nan", "slow-batch",
                "progcache-fingerprint", "chunk-crash",
                "handoff-corrupt")
 
+# Router-tier chaos kinds (full spec names - they keep their prefix,
+# unlike serve specs, because `router-` and `store-` faults fire in
+# DIFFERENT modules: the router data path, fleet/store.py loads, and
+# fleet/ha.py lease renewals respectively).
+ROUTER_KINDS = ("router-crash", "store-corrupt", "store-stale-lease")
+_ROUTER_PREFIXES = ("router-", "store-")
+
 # Program-identity fields a selector may match on (ctx keys the serve
 # seams pass to `fire`).
 _SELECTOR_FIELDS = ("n", "timesteps", "scheme", "path", "k", "dtype")
@@ -231,10 +242,10 @@ class ServeInjection:
     def __init__(self, kind: str, match: Optional[Dict[str, str]] = None,
                  count: Optional[int] = None, after: int = 0,
                  seconds: float = 0.0):
-        if kind not in SERVE_KINDS:
+        if kind not in SERVE_KINDS and kind not in ROUTER_KINDS:
             raise ValueError(
                 f"unknown serve fault kind {kind!r}; want one of "
-                f"{SERVE_KINDS}"
+                f"{SERVE_KINDS + ROUTER_KINDS}"
             )
         self.kind = kind
         self.match = dict(match or {})
@@ -378,3 +389,70 @@ def serve_plan_from_env(env: Optional[dict] = None
     if not spec:
         return None
     return parse_serve_spec(spec)
+
+
+# ------------------------------------------------------------ router tier
+
+
+def parse_router_spec(spec: str) -> Optional[ServeFaultPlan]:
+    """Parse the router-tier halves of a WAVETPU_FAULT value (None when
+    the value carries none).  Grammar mirrors the serve specs -
+    `KIND[:key=value,...]` with `count`/`after` budgets, ';'-separated,
+    freely mixed with serve-side and run-side specs:
+
+     * `router-crash[:after=K,count=N]` - the router process delivers
+       SIGKILL to ITSELF just before proxying a matching /solve
+       (`after=K` skips the first K), the real-dead-active half of the
+       failover drill: no flush, no lease release, nothing graceful;
+     * `store-corrupt[:count=N]` - the control-plane WAL tail is
+       truncated just before a store load, driving the per-line
+       checksum rejection branch (a counted recoverable miss);
+     * `store-stale-lease[:count=N]` - one lease renewal observes a
+       stale/foreign lease and fails, forcing the active to demote and
+       re-elect (the paused-then-resumed-process drill).
+
+    Every firing is counted; the router exposes the plan's state as
+    `wavetpu_router_fault_injections_total{kind=}`."""
+    injections: List[ServeInjection] = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part or not part.startswith(_ROUTER_PREFIXES):
+            continue
+        kind, _, params = part.partition(":")
+        count: Optional[int] = None
+        after = 0
+        if params:
+            for kv in params.split(","):
+                kv = kv.strip()
+                if not kv:
+                    continue
+                k, sep, v = kv.partition("=")
+                if not sep:
+                    raise ValueError(
+                        f"{ENV_FAULT}: {kind} wants key=value params, "
+                        f"got {kv!r}"
+                    )
+                if k == "count":
+                    count = int(v)
+                elif k == "after":
+                    after = int(v)
+                else:
+                    raise ValueError(
+                        f"{ENV_FAULT}: {kind} takes only count=/after= "
+                        f"params, got {kv!r}"
+                    )
+        injections.append(ServeInjection(kind, count=count, after=after))
+    return ServeFaultPlan(injections) if injections else None
+
+
+def router_plan_from_env(env: Optional[dict] = None
+                         ) -> Optional[ServeFaultPlan]:
+    """The router tier's WAVETPU_FAULT port (None when unset or when
+    the value carries only run/serve-side specs).  One plan per router
+    process, shared across the data path, the store, and the lease so
+    `count=` budgets mean what they say."""
+    env = os.environ if env is None else env
+    spec = env.get(ENV_FAULT)
+    if not spec:
+        return None
+    return parse_router_spec(spec)
