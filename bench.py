@@ -1470,6 +1470,135 @@ def _occupancy_sweep(interp):
     return rows
 
 
+def _resultcache_row(interp):
+    """The fleet-memory tier priced, both directions.  Twin stacks
+    (replica + single-member router) over the SAME hotkey trace, one
+    with --result-cache/--edge-cache on, one off.  Hit path: the
+    warm replay's p95 on the cache-on stack (repeats answered from
+    memory, mostly at the router edge) vs the cache-off stack's warm
+    solve p95, plus the aggregate requests/s uplift.  Miss path: an
+    all-distinct-bodies replay (per-request phases fork every result
+    key while the compiled PROGRAM stays warm) through both stacks -
+    the cache-on delta is the pure rent of key derivation + lookup +
+    store, bar <= 2% p95."""
+    import copy
+    import threading
+    import traceback
+
+    from wavetpu.fleet.router import build_router
+    from wavetpu.loadgen import report as lg_report
+    from wavetpu.loadgen import runner, trace
+    from wavetpu.serve.api import build_server
+
+    n, steps, kernel = (8, 6, "roll") if interp else (64, 20, "auto")
+    scenarios = trace.default_scenarios(n=n, timesteps=steps)
+    hotkey = trace.generate(
+        "hotkey", duration=3.0, qps=8.0, scenarios=scenarios, seed=29,
+        distinct=2,
+    )
+    def fork_phases(offset):
+        # phase shapes the ANSWER (not the program): every body gets a
+        # unique result key, so the cache-on stack misses every time
+        # while marching the same warm compiled program.  Two forks:
+        # one warms every batch bucket on BOTH stacks (coalescing
+        # would otherwise hold the cache-on stack at occupancy 1 and
+        # leave its larger buckets cold), one is the measured miss
+        # replay (keys unseen by either the warmup or the cache).
+        recs = copy.deepcopy(hotkey)
+        for i, rec in enumerate(recs):
+            rec["body"]["phase"] = round(offset + 0.001 * (i + 1), 6)
+        return recs
+
+    warm_bodies = fork_phases(0.0)
+    miss_bodies = fork_phases(0.5)
+
+    def stack(cached):
+        httpd, state = build_server(
+            port=0, max_wait=0.02, default_kernel=kernel,
+            interpret=interp, result_cache=cached,
+        )
+        threading.Thread(
+            target=httpd.serve_forever, daemon=True
+        ).start()
+        u = f"http://127.0.0.1:{httpd.server_address[1]}"
+        rh, rs = build_router(
+            [u], poll_interval_s=0.5, edge_cache=cached
+        )
+        threading.Thread(target=rh.serve_forever, daemon=True).start()
+        ru = f"http://127.0.0.1:{rh.server_address[1]}"
+        return (httpd, state, rh, rs), ru
+
+    def teardown(stk):
+        httpd, state, rh, rs = stk
+        rs.stop_poller()
+        rh.shutdown()
+        rh.server_close()
+        httpd.shutdown()
+        state.batcher.close()
+        httpd.server_close()
+
+    def run(base, records):
+        res = runner.replay(base, records, mode="closed",
+                            concurrency=4, timeout=1800)
+        return lg_report.build_report(res, target=base)
+
+    try:
+        on_stk, on_url = stack(True)
+        off_stk, off_url = stack(False)
+        try:
+            run(on_url, warm_bodies)      # warm every batch bucket
+            run(on_url, hotkey)           # cold pass: fills both tiers
+            rep_hit = run(on_url, hotkey)   # warm: the hit path
+            rep_miss_on = run(on_url, miss_bodies)   # miss-path rent
+            run(off_url, warm_bodies)     # same bucket warmup
+            rep_solve = run(off_url, hotkey)      # solve-path twin
+            rep_miss_off = run(off_url, miss_bodies)
+        finally:
+            teardown(on_stk)
+            teardown(off_stk)
+        hit_p95 = rep_hit["latency_ms"]["p95_ms"]
+        solve_p95 = rep_solve["latency_ms"]["p95_ms"]
+        miss_on = rep_miss_on["latency_ms"]["p95_ms"]
+        miss_off = rep_miss_off["latency_ms"]["p95_ms"]
+        hit_rps = rep_hit["requests_per_s"]
+        solve_rps = rep_solve["requests_per_s"]
+        return {
+            "requests": rep_hit["requests"],
+            "duplicate_rate": rep_hit.get("duplicate_rate"),
+            "hit_rate": rep_hit.get("cache_hit_rate"),
+            "cache_tiers": (rep_hit.get("server") or {}).get("cache"),
+            "hit_p95_ms": hit_p95,
+            "solve_p95_ms": solve_p95,
+            "hit_vs_solve_p95_speedup": round(
+                solve_p95 / hit_p95, 2
+            ) if hit_p95 else None,
+            "requests_per_s_cache_on": hit_rps,
+            "requests_per_s_cache_off": solve_rps,
+            "requests_per_s_uplift": round(
+                hit_rps / solve_rps, 2
+            ) if solve_rps else None,
+            "miss_p95_ms_cache_on": miss_on,
+            "miss_p95_ms_cache_off": miss_off,
+            "overhead_pct": round(
+                100.0 * (miss_on - miss_off) / miss_off, 2
+            ) if miss_off else None,
+            "errors": rep_hit["errors"] + rep_miss_on["errors"],
+            "policy": "best_of_1",
+            "config": (
+                f"hotkey mix distinct=2, {len(hotkey)} reqs, closed "
+                f"loop c=4, N={n}/{steps} kernel={kernel}; twin "
+                f"stacks replica+router, result/edge cache on vs off; "
+                f"hit path = warm hotkey replay, miss path = "
+                f"all-distinct phases (warm programs/buckets, cold "
+                f"keys), bar <= 2% p95"
+            ),
+        }
+    except Exception:
+        print("resultcache sub-benchmark failed:", file=sys.stderr)
+        traceback.print_exc()
+        return {"error": "failed; see stderr"}
+
+
 def main() -> int:
     import jax
     import jax.numpy as jnp
@@ -1791,6 +1920,10 @@ def main() -> int:
     # aggressor-vs-victim isolation drill (victim p95 <= 1.5x unloaded,
     # zero victim errors, aggressor quota 429s absorbed by retries).
     subs["qos"] = _qos_row(interp)
+    # Fleet memory: hotkey replay cache-on vs cache-off twins - hit
+    # path p95 vs solve p95 + requests/s uplift, and the miss-path
+    # rent (<= 2% p95 bar on all-distinct bodies).
+    subs["resultcache"] = _resultcache_row(interp)
     line = {
         "metric": "gcell_updates_per_s",
         "value": head["gcells_per_s"],
@@ -1903,6 +2036,13 @@ def main() -> int:
         "qos_victim_p95_ratio": subs["qos"].get("victim_p95_ratio"),
         "qos_victim_errors": subs["qos"].get("victim_errors"),
         "qos_aggressor_429s": subs["qos"].get("aggressor_quota_429s"),
+        "resultcache_hit_rate": subs["resultcache"].get("hit_rate"),
+        "resultcache_hit_p95_ms": subs["resultcache"].get(
+            "hit_p95_ms"
+        ),
+        "resultcache_overhead_pct": subs["resultcache"].get(
+            "overhead_pct"
+        ),
         "headline_summary": True,
     }
     print(json.dumps(summary))

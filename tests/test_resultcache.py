@@ -1,0 +1,454 @@
+"""Result-cache tier contracts: the jax-free result_key derivation
+(answer-shaping fields change the key, encoding order does not), the
+replica ResultCache bounds (LRU bytes / TTL / fingerprint / digest),
+the HTTP pins - a cache hit is BYTE-IDENTICAL to the fresh solve and
+skips the march, `Cache-Control: no-cache` bypasses, singleflight
+collapses N concurrent identical requests onto ONE executed batch -
+the two WAVETPU_FAULT corruption drills (counted miss, clean
+recompute, zero breaker events), and the router edge tier: a repeat
+answered at the router with ZERO replica I/O, surviving an HA
+failover via the control-plane store.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from wavetpu import progkey
+from wavetpu.fleet import ha as fleet_ha
+from wavetpu.fleet.edgecache import EdgeCache
+from wavetpu.fleet.router import build_router
+from wavetpu.run import faults
+from wavetpu.serve.api import build_server
+from wavetpu.serve.resultcache import ResultCache
+
+
+# ---- plumbing (mirrors test_fleet.py; raw-bytes POST is the point:
+# the byte-identity pin must compare wire bytes, not re-parsed JSON) --
+
+
+def _post_raw(base, path, body, timeout=60, headers=None):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def _metrics_json(base, timeout=30):
+    req = urllib.request.Request(base + "/metrics")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _start_replica(**kw):
+    kw.setdefault("max_wait", 0.02)
+    kw.setdefault("default_kernel", "roll")
+    kw.setdefault("interpret", True)
+    httpd, state = build_server(port=0, **kw)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, state, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def _stop_replica(httpd, state):
+    try:
+        httpd.shutdown()
+    except Exception:
+        pass
+    state.batcher.close(timeout=30.0, drain=False)
+    httpd.server_close()
+
+
+def _start_router(member_urls, **kw):
+    import random
+
+    kw.setdefault("poll_interval_s", 60.0)  # tests poll explicitly
+    kw.setdefault("rng", random.Random(0))
+    httpd, state = build_router(member_urls, **kw)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, state, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def _stop_router(httpd, state, release=True):
+    if getattr(state, "ha", None) is not None:
+        state.ha.stop(release=release)
+    state.stop_poller()
+    httpd.shutdown()
+    httpd.server_close()
+
+
+# ---- the shared result-key derivation ----
+
+
+class TestResultKey:
+    def test_answer_shaping_fields_change_the_key(self):
+        base = progkey.result_key({"N": 8, "timesteps": 4})
+        # phase/steps/c2_field change the ANSWER (not the compiled
+        # program) - they MUST fork the result key even though the
+        # affinity identity treats them as irrelevant.
+        assert base != progkey.result_key(
+            {"N": 8, "timesteps": 4, "phase": 1.0}
+        )
+        assert base != progkey.result_key(
+            {"N": 8, "timesteps": 4, "c2_field": "gaussian-lens"}
+        )
+        assert base != progkey.result_key({"N": 8, "timesteps": 5})
+
+    def test_key_is_encoding_order_invariant(self):
+        a = progkey.result_key({"N": 8, "timesteps": 4, "k": 2})
+        b = progkey.result_key({"k": 2, "timesteps": 4, "N": 8})
+        assert a == b
+
+    def test_rejects_what_the_server_rejects(self):
+        with pytest.raises(ValueError):
+            progkey.result_key({"timesteps": 4})  # missing N
+
+    def test_eligibility_is_conservative(self):
+        assert progkey.result_cache_eligible({"N": 8, "timesteps": 4})
+        # a resume-token request continues recorded state - its answer
+        # depends on MORE than the body, so it must never be cached
+        assert not progkey.result_cache_eligible(
+            {"N": 8, "timesteps": 4, "resume_token": "tok"}
+        )
+        assert not progkey.result_cache_eligible("not a dict")
+        assert not progkey.result_cache_eligible(None)
+
+
+# ---- the replica cache's bounds (unit, injected clock) ----
+
+
+class TestResultCacheBounds:
+    def _cache(self, **kw):
+        self.now = [0.0]
+        kw.setdefault("clock", lambda: self.now[0])
+        return ResultCache(**kw)
+
+    def test_lru_evicts_oldest_when_over_bytes(self):
+        c = self._cache(max_bytes=100, ttl_s=60.0)
+        assert c.put("a", b"x" * 40)
+        assert c.put("b", b"y" * 40)
+        assert c.put("c", b"z" * 40)  # over 100 -> "a" goes
+        snap = c.snapshot()
+        assert snap["entries"] == 2 and snap["bytes"] <= 100
+        assert c.snapshot()["events"]["evict_lru"] == 1
+        assert c.get("a") is None
+        assert c.get("b") is not None and c.get("c") is not None
+
+    def test_hit_refreshes_lru_order(self):
+        c = self._cache(max_bytes=100, ttl_s=60.0)
+        c.put("a", b"x" * 40)
+        c.put("b", b"y" * 40)
+        assert c.get("a") is not None  # "a" is now most-recent
+        c.put("c", b"z" * 40)          # so "b" is the victim
+        assert c.get("b") is None and c.get("a") is not None
+
+    def test_oversized_payload_rejected_not_thrashed(self):
+        c = self._cache(max_bytes=100, ttl_s=60.0)
+        c.put("a", b"x" * 40)
+        assert not c.put("big", b"z" * 200)
+        # the oversized answer must not have evicted the resident set
+        assert c.get("a") is not None
+        assert c.snapshot()["entries"] == 1
+
+    def test_ttl_expiry_is_a_counted_miss(self):
+        c = self._cache(max_bytes=100, ttl_s=10.0)
+        c.put("a", b"payload")
+        self.now[0] = 11.0
+        assert c.get("a") is None
+        ev = c.snapshot()["events"]
+        assert ev["evict_ttl"] == 1 and ev["miss"] == 1
+        assert c.snapshot()["entries"] == 0
+
+    def test_fingerprint_drift_invalidates(self):
+        c = self._cache(max_bytes=100, ttl_s=60.0,
+                        fingerprint={"jaxlib": "0.4.0"})
+        c.put("a", b"payload")
+        assert c.get("a") is not None
+        c.fingerprint = {"jaxlib": "0.5.0"}  # the upgrade landed
+        assert c.get("a") is None
+        assert c.snapshot()["events"]["fingerprint_mismatch"] == 1
+
+    def test_real_corruption_is_detected_and_dropped(self):
+        c = self._cache(max_bytes=100, ttl_s=60.0)
+        c.put("a", b"payload-bytes")
+        with c._lock:  # bit-rot the resident copy behind the API
+            c._entries["a"].payload = b"payload-bytEs"
+        assert c.get("a") is None
+        ev = c.snapshot()["events"]
+        assert ev["corrupt"] == 1 and c.snapshot()["entries"] == 0
+
+
+# ---- the HTTP contract: byte-identity, bypass, singleflight ----
+
+
+BODY = {"N": 8, "timesteps": 4}
+
+
+class TestReplicaCacheHTTP:
+    def test_hit_is_byte_identical_and_skips_the_march(self):
+        httpd, state, base = _start_replica(result_cache=True)
+        try:
+            code, fresh, h1 = _post_raw(base, "/solve", BODY)
+            assert code == 200
+            assert h1.get("X-Wavetpu-Cache", "").startswith("store;fp=")
+            batches = _metrics_json(base)["batches_total"]
+
+            code, cached, h2 = _post_raw(base, "/solve", BODY)
+            assert code == 200
+            assert h2.get("X-Wavetpu-Cache") == "hit"
+            # THE pin: the hit replays the exact bytes the cold client
+            # saw - not a re-serialization that happens to parse equal.
+            assert cached == fresh
+            assert "cache;desc=hit" in h2.get("Server-Timing", "")
+            snap = _metrics_json(base)
+            assert snap["batches_total"] == batches  # no march
+            assert snap["result_cache"]["events"]["hit"] == 1
+        finally:
+            _stop_replica(httpd, state)
+
+    def test_no_cache_header_bypasses_and_recomputes(self):
+        httpd, state, base = _start_replica(result_cache=True)
+        try:
+            code, _, _ = _post_raw(base, "/solve", BODY)
+            assert code == 200
+            batches = _metrics_json(base)["batches_total"]
+            code, _, h = _post_raw(
+                base, "/solve", BODY,
+                headers={"Cache-Control": "no-cache"},
+            )
+            assert code == 200
+            assert h.get("X-Wavetpu-Cache") != "hit"
+            snap = _metrics_json(base)
+            assert snap["batches_total"] == batches + 1  # re-marched
+            assert snap["result_cache"]["events"]["bypass"] == 1
+        finally:
+            _stop_replica(httpd, state)
+
+    def test_cache_off_by_default(self):
+        httpd, state, base = _start_replica()
+        try:
+            for _ in range(2):
+                code, _, h = _post_raw(base, "/solve", BODY)
+                assert code == 200
+                assert "X-Wavetpu-Cache" not in h
+            snap = _metrics_json(base)
+            assert "result_cache" not in snap
+        finally:
+            _stop_replica(httpd, state)
+
+    def test_singleflight_collapses_concurrent_identicals(self):
+        """N identical concurrent requests -> exactly ONE executed
+        march; followers fan out the primary's answer byte-identically
+        and are individually counted."""
+        httpd, state, base = _start_replica(
+            result_cache=True, max_wait=0.3
+        )
+        try:
+            results = []
+            lock = threading.Lock()
+
+            def worker():
+                out = _post_raw(base, "/solve", BODY)
+                with lock:
+                    results.append(out)
+
+            threads = [threading.Thread(target=worker)]
+            threads[0].start()
+            time.sleep(0.1)  # primary is parked in the batch window
+            for _ in range(4):
+                t = threading.Thread(target=worker)
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join(120)
+            assert len(results) == 5
+            assert all(code == 200 for code, _, _ in results)
+            payloads = {bytes(body) for _, body, _ in results}
+            assert len(payloads) == 1  # one answer, fanned out
+            tags = sorted(
+                h.get("X-Wavetpu-Cache", "") for _, _, h in results
+            )
+            assert sum(1 for t in tags if t == "coalesced") == 4
+            snap = _metrics_json(base)
+            assert snap["batches_total"] == 1  # the acceptance pin
+            assert snap["coalesced_total"] == 4
+            # riders are still individually accounted
+            assert snap["requests_total"] == 5
+        finally:
+            _stop_replica(httpd, state)
+
+
+# ---- the chaos drills: corruption is a counted miss, never a wrong
+# answer, never a breaker event ----
+
+
+class TestChaosDrills:
+    @pytest.mark.parametrize("kind,event", [
+        ("resultcache-corrupt", "corrupt"),
+        ("resultcache-stale-fingerprint", "fingerprint_mismatch"),
+    ])
+    def test_corruption_recomputes_cleanly(self, kind, event):
+        plan = faults.parse_serve_spec(f"serve-{kind}:count=1")
+        httpd, state, base = _start_replica(
+            result_cache=True, fault_plan=plan
+        )
+        try:
+            code, fresh, _ = _post_raw(base, "/solve", BODY)
+            assert code == 200
+            # the armed fault fires on this lookup: the entry is
+            # rejected, the request falls through to a clean recompute
+            code, recomputed, h = _post_raw(base, "/solve", BODY)
+            assert code == 200
+            assert h.get("X-Wavetpu-Cache") != "hit"
+            # never a wrong answer: the recomputed ANSWER matches the
+            # original (timing fields legitimately differ per march)
+            def answer(raw):
+                rep = json.loads(raw)["report"]
+                return {k: rep[k] for k in (
+                    "problem", "final_step", "max_abs_error",
+                    "abs_errors", "rel_errors",
+                )}
+            assert answer(recomputed) == answer(fresh)
+            snap = _metrics_json(base)
+            ev = snap["result_cache"]["events"]
+            assert ev[event] == 1 and ev["miss"] >= 1
+            # a cache losing an entry says nothing about the program:
+            assert snap["breaker"]["open"] == 0
+            assert snap["breaker"]["keys"] == []
+            # budget spent -> the re-stored answer now hits,
+            # byte-identical to the recompute that refilled it
+            code, again, h = _post_raw(base, "/solve", BODY)
+            assert code == 200 and h.get("X-Wavetpu-Cache") == "hit"
+            assert again == recomputed
+        finally:
+            _stop_replica(httpd, state)
+
+
+# ---- the router edge tier ----
+
+
+class TestEdgeCacheUnit:
+    def test_export_restore_roundtrip_with_corrupt_entry_skipped(self):
+        a = EdgeCache(max_bytes=1 << 20, ttl_s=600.0)
+        a.put("k1", b'{"ok":1}', "application/json", "total;dur=1",
+              fp="aaaa")
+        a.put("k2", b'{"ok":2}', "application/json", None, fp="aaaa")
+        state = a.export_state()
+        for e in state["entries"]:
+            if e["key"] == "k2":
+                e["digest"] = "0" * 64  # WAL bit-rot
+        b = EdgeCache(max_bytes=1 << 20, ttl_s=600.0)
+        b.restore_state(state)
+        hit = b.get("k1")
+        assert hit is not None and hit[0] == b'{"ok":1}'
+        assert b.get("k2") is None  # corrupt record cost ITS entry only
+        assert b.corrupt_total >= 1
+
+    def test_fingerprint_change_flushes_the_index(self):
+        c = EdgeCache(max_bytes=1 << 20, ttl_s=600.0)
+        c.put("k1", b'{"ok":1}', "application/json", None, fp="aaaa")
+        c.put("k2", b'{"ok":2}', "application/json", None, fp="bbbb")
+        # the fleet's environment moved: every pre-drift answer is gone
+        assert c.get("k1") is None
+        assert c.get("k2") is not None
+        assert c.fingerprint_flushes_total == 1
+
+
+class TestRouterEdgeCache:
+    def test_edge_hit_answers_with_zero_replica_io(self):
+        h, s, u = _start_replica(result_cache=True)
+        router_httpd, rstate, base = _start_router(
+            [u], edge_cache=True, proxy_timeout=60.0
+        )
+        try:
+            code, fresh, h1 = _post_raw(base, "/solve", BODY)
+            assert code == 200
+            assert h1.get("X-Wavetpu-Cache", "").startswith("store;fp=")
+            replica = _metrics_json(u)
+            batches, requests = (
+                replica["batches_total"], replica["requests_total"]
+            )
+
+            code, cached, h2 = _post_raw(base, "/solve", BODY)
+            assert code == 200
+            assert h2.get("X-Wavetpu-Cache") == "edge-hit"
+            assert cached == fresh  # byte-identical at the edge too
+            assert "cache;desc=edge-hit" in h2.get("Server-Timing", "")
+            replica = _metrics_json(u)
+            # ZERO replica I/O: not merely "no batch" - the replica
+            # never even saw an HTTP request for the repeat.
+            assert replica["batches_total"] == batches
+            assert replica["requests_total"] == requests
+            assert rstate.edge.hits_total == 1
+        finally:
+            _stop_router(router_httpd, rstate)
+            _stop_replica(h, s)
+
+    def test_no_cache_bypasses_the_edge(self):
+        h, s, u = _start_replica(result_cache=True)
+        router_httpd, rstate, base = _start_router(
+            [u], edge_cache=True, proxy_timeout=60.0
+        )
+        try:
+            assert _post_raw(base, "/solve", BODY)[0] == 200
+            replica_ok = _metrics_json(u)["responses_ok"]
+            code, _, hdr = _post_raw(
+                base, "/solve", BODY,
+                headers={"Cache-Control": "no-cache"},
+            )
+            assert code == 200
+            assert hdr.get("X-Wavetpu-Cache") != "edge-hit"
+            # the bypass went all the way to a replica (which may
+            # itself answer from ITS cache - that is the replica's
+            # call; the EDGE must not have short-circuited)
+            assert _metrics_json(u)["responses_ok"] == replica_ok + 1
+        finally:
+            _stop_router(router_httpd, rstate)
+            _stop_replica(h, s)
+
+    def test_ha_failover_inherits_the_edge_index(self, tmp_path):
+        """Router A stores an edge answer, hands off through the
+        control-plane store; promoted router B answers the repeat from
+        ITS edge - the replica never hears about the failover."""
+        cp = str(tmp_path / "cp")
+        h, s, u = _start_replica(result_cache=True)
+        ha_httpd, sa, ba = _start_router(
+            [u], edge_cache=True, proxy_timeout=60.0,
+            control_plane_dir=cp, store_flush_interval_s=0.05,
+        )
+        try:
+            assert sa.role == fleet_ha.ACTIVE
+            code, fresh, h1 = _post_raw(ba, "/solve", BODY)
+            assert code == 200
+            assert h1.get("X-Wavetpu-Cache", "").startswith("store;fp=")
+        finally:
+            _stop_router(ha_httpd, sa)  # orderly: flush + release
+        hb, sb, bb = _start_router(
+            [u], edge_cache=True, proxy_timeout=60.0,
+            control_plane_dir=cp, store_flush_interval_s=0.05,
+        )
+        try:
+            assert sb.role == fleet_ha.ACTIVE
+            replica = _metrics_json(u)
+            batches, requests = (
+                replica["batches_total"], replica["requests_total"]
+            )
+            code, cached, h2 = _post_raw(bb, "/solve", BODY)
+            assert code == 200
+            assert h2.get("X-Wavetpu-Cache") == "edge-hit"
+            assert cached == fresh
+            replica = _metrics_json(u)
+            assert replica["batches_total"] == batches
+            assert replica["requests_total"] == requests
+        finally:
+            _stop_router(hb, sb)
+            _stop_replica(h, s)

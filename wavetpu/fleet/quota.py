@@ -131,6 +131,19 @@ class TokenBucket:
                 return True, 0.0
             return False, (cost - self._tokens) / self.rate
 
+    def refund(self, cost: float) -> None:
+        """Return `cost` tokens (capped at burst).  Used when a spend
+        turns out to have priced work that never happened - a /solve
+        the replica answered from its result cache or coalesced onto an
+        in-flight march costs near-zero cells, not the analytic model's
+        full volume."""
+        if cost <= 0:
+            return
+        now = time.monotonic()
+        with self._lock:
+            self._refill_locked(now)
+            self._tokens = min(self.burst, self._tokens + cost)
+
     def tokens(self) -> float:
         now = time.monotonic()
         with self._lock:
@@ -263,6 +276,20 @@ class QuotaManager:
                 self._note_rejected(cfg.tenant)
                 return False, retry
         return True, 0.0
+
+    def refund_cells(self, tenant: str, cells: float) -> None:
+        """Return model-priced cells to a tenant's bucket after the
+        fleet learned the request was answered WITHOUT marching (result
+        -cache hit or singleflight ride): the tenant keeps paying the
+        1-token request rate - every request is individually charged -
+        but the cells price collapses to the measured near-zero cost of
+        a cache lookup.  No-op for tenants with no cells bucket."""
+        if cells <= 0:
+            return
+        with self._lock:
+            cb = self._cells.get(tenant)
+        if cb is not None:
+            cb.refund(min(cells, cb.burst))
 
     def _note_rejected(self, tenant: str) -> None:
         with self._lock:

@@ -99,6 +99,7 @@ from wavetpu.fleet.affinity import (
     AffinityTable,
     warm_label_from_server_timing,
 )
+from wavetpu.fleet.edgecache import EdgeCache
 from wavetpu.fleet.membership import LEFT, MembershipTable
 from wavetpu.fleet.store import ControlPlaneStore
 from wavetpu.obs import tracing
@@ -117,7 +118,8 @@ _USAGE = (
     "[--quota-default-cells-per-s C] [--quota-default-cells-burst CB] "
     "[--proxy-token SECRET] [--telemetry-dir DIR] "
     "[--control-plane-dir DIR] [--lease-ttl-s S] "
-    "[--store-flush-interval-s S]"
+    "[--store-flush-interval-s S] "
+    "[--edge-cache] [--edge-cache-max-bytes B] [--edge-cache-ttl-s S]"
 )
 
 # Response headers worth forwarding verbatim from replica to client
@@ -126,6 +128,7 @@ _USAGE = (
 # overwrites it with its own outer-hop context before answering.
 _FORWARD_RESPONSE_HEADERS = (
     "X-Request-Id", "Server-Timing", "Retry-After", "traceparent",
+    "X-Wavetpu-Cache",
 )
 # Request headers forwarded replica-ward.  X-Wavetpu-Tenant and
 # X-Priority pass through only on an UNauthenticated router (trusted
@@ -303,6 +306,13 @@ class RouterState:
         # it - the historical standalone-active router, bit-for-bit).
         self.store: Optional[ControlPlaneStore] = None
         self.ha: Optional[fleet_ha.HACoordinator] = None
+        # Edge result cache (--edge-cache; fleet/edgecache.py, None =
+        # off): repeats of a replica-stored answer are served AT the
+        # router - zero replica I/O, pinned by an unchanged replica
+        # batch counter.  Its index rides the control-plane store as
+        # the `edge_cache` section, so restarts and HA promotions
+        # inherit the warm edge.
+        self.edge: Optional[EdgeCache] = None
         # Router-tier chaos plan (WAVETPU_FAULT router-*/store-* specs;
         # run/faults.py router_plan_from_env).  Shared with the store
         # and lease so count= budgets span the whole process.
@@ -345,12 +355,15 @@ class RouterState:
                 "proxied_per_member": dict(self.proxied_per_member),
                 "requests_per_tenant": dict(self.requests_per_tenant),
             }
-        return {
+        out = {
             "quota": self.quotas.export_state(),
             "affinity": self.affinity.export_state(),
             "membership": self.table.export_state(),
             "router_counters": counters,
         }
+        if self.edge is not None:
+            out["edge_cache"] = self.edge.export_state()
+        return out
 
     def restore_state(self, state: dict) -> None:
         """Adopt a predecessor's persisted state (boot with a store, or
@@ -363,6 +376,8 @@ class RouterState:
         self.quotas.restore_state(state.get("quota") or {})
         self.affinity.restore_state(state.get("affinity") or {})
         self.table.restore_state(state.get("membership") or {})
+        if self.edge is not None:
+            self.edge.restore_state(state.get("edge_cache") or {})
         counters = state.get("router_counters")
         if not isinstance(counters, dict):
             return
@@ -545,6 +560,8 @@ class RouterState:
             snap["ha"] = self.ha.snapshot()
         if self.store is not None:
             snap["store"] = self.store.snapshot_counters()
+        if self.edge is not None:
+            snap["edge_cache"] = self.edge.snapshot()
         if self.fault_plan is not None:
             snap["fault_plan"] = self.fault_plan.snapshot()
         snap["affinity"] = self.affinity.stats()
@@ -617,6 +634,8 @@ class RouterState:
             own.update(self.store.prom_samples())
         if self.ha is not None:
             own.update(self.ha.prom_samples())
+        if self.edge is not None:
+            own.update(self.edge.prom_samples())
         if self.fault_plan is not None:
             for inj in self.fault_plan.snapshot():
                 own[
@@ -859,13 +878,32 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 st.requests_per_tenant[tenant] = (
                     st.requests_per_tenant.get(tenant, 0) + 1
                 )
-        # ONE body parse, shared by quota pricing (here) and the
-        # affinity-key derivation (_route_solve).
+        # ONE body parse, shared by quota pricing (here), the edge
+        # result-cache key, and the affinity-key derivation
+        # (_route_solve).
         self._body_obj = None
         try:
             self._body_obj = json.loads(raw)
         except (ValueError, TypeError):
             pass
+        # Edge result cache (fleet/edgecache.py): same jax-free key
+        # derivation the replica tier uses.  The key is computed even
+        # under `Cache-Control: no-cache` (the fresh answer still
+        # refreshes the edge); only the LOOKUP is bypassed.
+        self._edge_key: Optional[str] = None
+        self._priced_cells = 0.0
+        edge_hit = None
+        if st.edge is not None and isinstance(self._body_obj, dict) \
+                and progkey.result_cache_eligible(self._body_obj):
+            try:
+                self._edge_key = progkey.result_key(
+                    self._body_obj, platform=st.platform
+                )
+            except (ValueError, TypeError, KeyError):
+                self._edge_key = None
+        if self._edge_key is not None and "no-cache" not in (
+                self.headers.get("Cache-Control") or "").lower():
+            edge_hit = st.edge.get(self._edge_key)
         # Priority-class authority: on an authenticated router the
         # effective class is the tenant's config default (when the
         # request declares none) clamped at its ceiling - the inbound
@@ -888,9 +926,15 @@ class _RouterHandler(BaseHTTPRequestHandler):
         if cfg is None and tenant and st.quotas.enforces_anything:
             cfg = quota.TenantConfig(tenant=tenant)
         if cfg is not None:
-            ok, retry = st.quotas.admit(
-                cfg, quota.price_cells(self._body_obj)
+            # An edge hit is still individually charged its request-
+            # rate token, but its cells price is the MEASURED cost of
+            # answering - a dict lookup, near zero - not the analytic
+            # model's full march volume.
+            self._priced_cells = (
+                0.0 if edge_hit is not None
+                else quota.price_cells(self._body_obj)
             )
+            ok, retry = st.quotas.admit(cfg, self._priced_cells)
             if not ok:
                 with st._lock:  # noqa: SLF001
                     st.quota_rejected_total += 1
@@ -938,7 +982,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
             )
         status = 0
         try:
-            status = self._route_solve(raw, t0, tenant)
+            if edge_hit is not None:
+                status = self._serve_edge_hit(edge_hit, t0)
+            else:
+                status = self._route_solve(raw, t0, tenant)
         finally:
             with st._lock:  # noqa: SLF001
                 st.proxy_wall_ms_total += (
@@ -946,6 +993,24 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 )
             if span is not None:
                 st.tracer.end(span, status=status)
+
+    def _serve_edge_hit(self, hit: Tuple[bytes, str, Optional[str]],
+                        t0: float) -> int:
+        """Answer a /solve from the edge index: the EXACT replica
+        payload bytes, with ZERO replica I/O (no forward, no queue
+        slot, no batch - the drill pins the replica batch counter
+        unchanged)."""
+        payload, content_type, _orig_timing = hit
+        out = {
+            "X-Wavetpu-Cache": "edge-hit",
+            "Server-Timing": (
+                f"cache;desc=edge-hit, "
+                f"total;dur={(time.monotonic() - t0) * 1e3:.3f}"
+            ),
+        }
+        self._send_bytes(200, payload, content_type,
+                         self._echo_headers(out))
+        return 200
 
     def _route_solve(self, raw: bytes, t0: float,
                      tenant: Optional[str]) -> int:
@@ -1116,6 +1181,28 @@ class _RouterHandler(BaseHTTPRequestHandler):
         retried = len(tried) > 1
         if last is not None and last[0] not in (0, 503):
             status, body, headers = last
+            cache_hdr = headers.get("X-Wavetpu-Cache") or ""
+            if status == 200 and cache_hdr:
+                if cache_hdr.startswith("store;fp=") \
+                        and st.edge is not None \
+                        and self._edge_key is not None:
+                    # The replica just stored this answer in ITS tier:
+                    # adopt the exact bytes at the edge under the
+                    # replica's fingerprint tag (a NEW tag flushes the
+                    # old fleet's entries).
+                    st.edge.put(
+                        self._edge_key, body,
+                        headers.get("Content-Type", "application/json"),
+                        headers.get("Server-Timing"),
+                        fp=cache_hdr[len("store;fp="):],
+                    )
+                elif cache_hdr in ("hit", "coalesced") and tenant \
+                        and self._priced_cells > 0:
+                    # Replica-tier cache hit / singleflight ride: no
+                    # march happened, so the analytic cells price
+                    # collapses to measured near-zero (the rps token
+                    # stays spent - every request is charged).
+                    st.quotas.refund_cells(tenant, self._priced_cells)
             out = {
                 h: headers[h]
                 for h in _FORWARD_RESPONSE_HEADERS if headers.get(h)
@@ -1191,6 +1278,9 @@ def build_router(
     store_flush_interval_s: float = 0.5,
     ha_owner: Optional[str] = None,
     start_ha: bool = True,
+    edge_cache: bool = False,
+    edge_cache_max_bytes: Optional[int] = None,
+    edge_cache_ttl_s: Optional[float] = None,
 ) -> Tuple[ThreadingHTTPServer, RouterState]:
     """Assemble membership + affinity + HTTP front (port 0 =
     ephemeral).  Does ONE synchronous poll before returning so the
@@ -1212,7 +1302,13 @@ def build_router(
     same dir boots standby and answers retriable standby-503s until
     the lease frees).  `ha_owner` names this router in the lease
     (default host:port#pid); `start_ha=False` leaves the coordinator
-    un-started for tests that drive ticks by hand."""
+    un-started for tests that drive ticks by hand.
+
+    `edge_cache` (--edge-cache, default OFF) turns on the router edge
+    result tier (fleet/edgecache.py): repeats of answers the replicas
+    stamped `X-Wavetpu-Cache: store;fp=H` are served at the router with
+    zero replica I/O, and with a control plane the index persists as
+    the store's `edge_cache` section (restart/HA-promotion warm)."""
     from wavetpu.run.faults import router_plan_from_env
 
     fault_plan = router_plan_from_env()
@@ -1228,6 +1324,17 @@ def build_router(
         quotas=quotas, proxy_token=proxy_token,
     )
     state.fault_plan = fault_plan
+    if edge_cache:
+        from wavetpu.fleet import edgecache as _edgecache
+
+        # Built BEFORE the HA coordinator: the first (synchronous)
+        # election restore adopts the persisted `edge_cache` section
+        # into this instance.
+        state.edge = EdgeCache(
+            max_bytes=(edge_cache_max_bytes
+                       or _edgecache.DEFAULT_MAX_BYTES),
+            ttl_s=edge_cache_ttl_s or _edgecache.DEFAULT_TTL_S,
+        )
     if telemetry_dir is not None:
         state.tracer = tracing.Tracer(
             os.path.join(telemetry_dir, TRACE_FILENAME),
@@ -1271,7 +1378,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    "quota-default-burst", "quota-default-cells-per-s",
                    "quota-default-cells-burst", "proxy-token",
                    "telemetry-dir", "control-plane-dir",
-                   "lease-ttl-s", "store-flush-interval-s"),
+                   "lease-ttl-s", "store-flush-interval-s",
+                   "edge-cache", "edge-cache-max-bytes",
+                   "edge-cache-ttl-s"),
+            valueless=("edge-cache",),
             allow_positionals=False,
             repeatable=("member",),
         )
@@ -1321,6 +1431,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         store_flush_interval_s = float(
             flags.get("store-flush-interval-s", "0.5")
         )
+        edge_cache_max_bytes = (
+            int(flags["edge-cache-max-bytes"])
+            if "edge-cache-max-bytes" in flags else None
+        )
+        edge_cache_ttl_s = (
+            float(flags["edge-cache-ttl-s"])
+            if "edge-cache-ttl-s" in flags else None
+        )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         print(_USAGE, file=sys.stderr)
@@ -1335,7 +1453,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         control_plane_dir=flags.get("control-plane-dir"),
         lease_ttl_s=lease_ttl_s,
         store_flush_interval_s=store_flush_interval_s,
+        edge_cache="edge-cache" in flags,
+        edge_cache_max_bytes=edge_cache_max_bytes,
+        edge_cache_ttl_s=edge_cache_ttl_s,
     )
+    if state.edge is not None:
+        print(
+            f"edge cache: on ({state.edge.max_bytes >> 20} MiB, "
+            f"ttl {state.edge.ttl_s:g}s)"
+        )
     if api_keys is not None:
         n_tenants = len({c.tenant for c in api_keys.values()})
         n_quota = sum(

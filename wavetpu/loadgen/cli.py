@@ -41,6 +41,10 @@ baseline-less replay when passed explicitly - the chaos smoke's
     --max-cold-compiles N      fresh-compile cap for the replay window
                                (0 = a warm program cache must serve
                                every program - the restart drill)
+    --min-cache-hit-rate F     result-cache hit-rate floor (replica
+                               hits + coalesced + edge hits, over
+                               requests) - the warm hotkey-replay
+                               drill's "repeats came from memory" check
     --tenant-slo T:KEY=V       per-tenant absolute gate (repeatable);
                                KEY is error-budget, reject-budget, or
                                p95-budget-ms.  The isolation drill pins
@@ -79,6 +83,7 @@ _SLO_FLAGS = {
     "p99-regression-pct": ("p99_regression_pct", float),
     "throughput-floor-pct": ("throughput_floor_pct", float),
     "max-cold-compiles": ("max_cold_compiles", int),
+    "min-cache-hit-rate": ("min_cache_hit_rate", float),
 }
 
 _TENANT_SLO_KEYS = {
@@ -237,6 +242,14 @@ def _replay(argv: Sequence[str]) -> int:
         f"{report['server']['cold_compiles']}; disk hits "
         f"{report['server']['disk_hits']}"
     )
+    cache = (report.get("server") or {}).get("cache")
+    if cache:
+        print(
+            f"cache: hit rate {report['cache_hit_rate']} "
+            f"(replica {cache['replica_hits']}, coalesced "
+            f"{cache['coalesced']}, edge {cache['edge_hits']}); "
+            f"duplicate rate {report['duplicate_rate']}"
+        )
     if retries:
         print(
             f"retries: {report['retried_requests']} of "
@@ -269,7 +282,8 @@ def _replay(argv: Sequence[str]) -> int:
     absolute = {
         k: v for k, v in slo.items()
         if k in ("p99_budget_ms", "error_budget", "reject_budget",
-                 "max_cold_compiles", "tenant_slos")
+                 "max_cold_compiles", "min_cache_hit_rate",
+                 "tenant_slos")
     }
     if absolute:
         # An explicitly-passed ABSOLUTE SLO gates even without a

@@ -40,6 +40,9 @@ DEFAULT_SLO = {
     "throughput_floor_pct": 50.0,  # req/s may drop this % under baseline
     "max_cold_compiles": None,    # fresh-compile cap (0 = "a warm
                                   # replica must compile nothing")
+    "min_cache_hit_rate": None,   # result-cache floor across all tiers
+                                  # (replica hits + coalesced riders +
+                                  # router edge hits, over requests)
     # Per-tenant absolute gates on the report's `tenants` breakdown:
     # {"TENANT": {"error_budget": F, "reject_budget": F,
     #             "p95_budget_ms": X}} - the isolation drill's "victim
@@ -164,6 +167,36 @@ def build_report(result, trace_path: Optional[str] = None,
             round(cells / solve_s / 1e9, 4) if solve_s else None
         ),
     }
+    # Result-cache traffic during the window, per tier: replica hits
+    # (stored solve replayed, no march), coalesced riders (fanned out
+    # from an identical in-flight solve), and router edge hits (zero
+    # replica I/O).  Omitted entirely when no cache tier moved, so
+    # pre-cache reports and baselines keep their exact shape.
+    cache_hits = int(_delta(
+        after, before,
+        'wavetpu_serve_resultcache_events_total{event="hit"}',
+    ))
+    coalesced = int(_delta(
+        after, before, "wavetpu_serve_coalesced_total",
+    ))
+    edge_hits = int(_delta(
+        after, before, "wavetpu_router_edgecache_hits_total",
+    ))
+    cache_stores = int(_delta(
+        after, before,
+        'wavetpu_serve_resultcache_events_total{event="store"}',
+    ))
+    if cache_hits or coalesced or edge_hits or cache_stores:
+        server["cache"] = {
+            "replica_hits": cache_hits,
+            "coalesced": coalesced,
+            "edge_hits": edge_hits,
+            "stores": cache_stores,
+            "misses": int(_delta(
+                after, before,
+                'wavetpu_serve_resultcache_events_total{event="miss"}',
+            )),
+        }
 
     # Per-target breakdown (repeated --target, i.e. a fleet driven
     # without a router in front): which replica served what, and which
@@ -250,6 +283,16 @@ def build_report(result, trace_path: Optional[str] = None,
         "requests_per_s": (
             round(n / result.wall_seconds, 3)
             if result.wall_seconds else None
+        ),
+        # Fraction of replayed bodies that were exact repeats of an
+        # earlier body in the same trace - the result-cache tiers'
+        # opportunity ceiling (a warm replay's hit rate approaches it).
+        "duplicate_rate": round(
+            getattr(result, "duplicate_rate", 0.0), 4
+        ),
+        "cache_hit_rate": (
+            round((cache_hits + coalesced + edge_hits) / n, 4)
+            if n else None
         ),
         "latency_ms": _pcts(lat_ms),
         "server_timing_mean_ms": timing_mean,
@@ -341,6 +384,16 @@ def gate(report: dict, baseline: Optional[dict] = None,
         fail("max_cold_compiles", cold, cfg["max_cold_compiles"],
              f"{cold} fresh compile(s) during replay exceeds budget "
              f"{cfg['max_cold_compiles']} (program cache not warm)")
+    # Result-cache gate: a WARM hotkey replay (same trace replayed
+    # twice through the same replica/router) asserts a hit-rate floor
+    # here - the CI-checkable form of "repeats were answered from
+    # memory, not re-marched".
+    hit_rate = report.get("cache_hit_rate")
+    if cfg["min_cache_hit_rate"] is not None and (
+            hit_rate is None or hit_rate < cfg["min_cache_hit_rate"]):
+        fail("min_cache_hit_rate", hit_rate, cfg["min_cache_hit_rate"],
+             f"cache hit rate {hit_rate} below floor "
+             f"{cfg['min_cache_hit_rate']} (result cache not warm)")
     # Per-tenant gates against the QoS breakdown: the isolation drill's
     # one-replay form (victim zero-error while the aggressor is
     # legitimately shedding 429s).
@@ -442,6 +495,17 @@ def format_gate(violations: Sequence[dict], report: dict,
             f"  {'compiles':<18} {srv.get('cold_compiles')} fresh, "
             f"{srv.get('disk_hits', 0)} disk hit(s), "
             f"{srv.get('warm_hits')} warm hit(s)"
+        )
+    cache = srv.get("cache")
+    if cache:
+        # Cache traffic per tier: the line CI greps to prove a warm
+        # replay was answered from memory (and WHERE - replica vs edge).
+        lines.append(
+            f"  {'cache':<18} rate "
+            f"{report.get('cache_hit_rate')!r} "
+            f"(replica {cache.get('replica_hits')}, coalesced "
+            f"{cache.get('coalesced')}, edge {cache.get('edge_hits')}; "
+            f"dup rate {report.get('duplicate_rate')!r})"
         )
     for section, singular in (("tenants", "tenant"), ("classes", "class")):
         # QoS breakdown: one line per tenant/class so the isolation

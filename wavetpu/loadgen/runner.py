@@ -176,6 +176,22 @@ class ReplayResult:
     targets: List[str] = dataclasses.field(default_factory=list)
     failover: bool = False             # --failover: one HA client
     endpoint_failovers: int = 0        # times the client rotated
+    # Share of replayed requests whose canonical body is a repeat of an
+    # earlier one - the result-cache tier's opportunity ceiling (a
+    # warm hit rate can never exceed it).
+    duplicate_rate: float = 0.0
+
+
+def duplicate_rate_of(records: Sequence[dict]) -> float:
+    """1 - unique canonical bodies / total over `records` (0.0 when
+    empty).  Canonicalized with sort_keys so key order never makes two
+    identical requests look distinct."""
+    bodies = [
+        json.dumps(r.get("body") or {}, sort_keys=True) for r in records
+    ]
+    if not bodies:
+        return 0.0
+    return 1.0 - len(set(bodies)) / len(bodies)
 
 
 def sum_metrics(cuts: Sequence[Dict[str, float]]) -> Dict[str, float]:
@@ -495,6 +511,7 @@ def replay(
             endpoint_failovers=(
                 shared.endpoint_failovers if shared is not None else 0
             ),
+            duplicate_rate=duplicate_rate_of(records),
         )
 
     outcomes: List[Optional[RequestOutcome]] = [None] * len(records)
@@ -562,4 +579,5 @@ def replay(
         endpoint_failovers=(
             shared.endpoint_failovers if shared is not None else 0
         ),
+        duplicate_rate=duplicate_rate_of(records),
     )

@@ -25,6 +25,7 @@ the same breaker, see `ServeEngine.breaker_key`) and MINUS
 
 from __future__ import annotations
 
+import hashlib
 import json
 from typing import Callable, List, NamedTuple, Optional, Tuple, Union
 
@@ -273,6 +274,50 @@ def identity_from_body(body: dict, default_kernel: str = "auto",
         k=fuse_steps if path == "kfused" else 1, dtype=dtype_name,
         with_field=with_field, mesh=mesh,
     )
+
+
+# Body fields beyond the program identity that change a deterministic
+# solve's ANSWER (not just its routing): per-lane phase, the early-stop
+# step, and the c2-field preset name.  `deadline_ms` / `priority` /
+# QoS headers shape scheduling, never the payload, so they are NOT part
+# of the result identity - two tenants replaying the same solve share
+# one cache entry.
+RESULT_FIELDS = ("phase", "steps", "c2_field")
+
+
+def result_cache_eligible(body) -> bool:
+    """Conservative result-cache eligibility: deterministic FULL solves
+    only.  A resume-token request continues a specific checkpointed
+    march (its answer depends on server-side state, not just the body),
+    so it must never be served from - or stored into - the result
+    cache."""
+    return isinstance(body, dict) and not body.get("resume_token")
+
+
+def result_key(body: dict, default_kernel: str = "auto",
+               platform: PlatformSource = None) -> str:
+    """The content-addressed RESULT identity of a /solve body: a sha256
+    hex digest over the canonical `RequestIdentity` projection plus the
+    answer-shaping RESULT_FIELDS.  Derived through the SAME
+    `identity_from_body` normalization the engine caches programs under
+    and the router routes by, so the replica result cache and the
+    router edge cache hash a body identically - the progcache/resume-
+    token discipline, extended to results.  Raises ValueError on a body
+    that yields no identity (the caller treats that as ineligible)."""
+    ident = identity_from_body(body, default_kernel, platform=platform)
+    p = ident.problem
+    payload = {
+        "N": p.N, "Np": p.Np, "Lx": p.Lx, "Ly": p.Ly, "Lz": p.Lz,
+        "T": p.T, "timesteps": p.timesteps, "scheme": ident.scheme,
+        "path": ident.path, "k": ident.k, "dtype": ident.dtype,
+        "with_field": ident.with_field,
+        "mesh": None if ident.mesh is None else list(ident.mesh),
+    }
+    for f in RESULT_FIELDS:
+        payload[f] = body.get(f)
+    return hashlib.sha256(
+        json.dumps(payload, sort_keys=True).encode()
+    ).hexdigest()
 
 
 def warm_keys_to_affinity(warm_keys: dict) -> List[str]:

@@ -55,7 +55,15 @@ and HTTP layer consult at their seams -
    checkpoint a resume presents is truncated on disk just before the
    load, driving the content-hash rejection branch: the resume must
    422 with `InvalidStateTokenError`, never a traceback, and the
-   circuit breaker must never hear it (serve/preempt.py).
+   circuit breaker must never hear it (serve/preempt.py);
+ * `serve-resultcache-corrupt[:SELECTOR,count=N]` - one payload byte
+   of the matching RESULT-cache entry flips just before a lookup
+   (serve/resultcache.py), driving the digest rejection branch: a
+   counted miss and a clean recompute, never a wrong answer;
+ * `serve-resultcache-stale-fingerprint[:SELECTOR,count=N]` - one
+   result-cache lookup observes a poisoned environment fingerprint
+   (the jaxlib-upgrade-under-a-warm-cache drill), driving the
+   cross-version rejection branch the same way.
 
 SELECTOR is `field=value` pairs matched against the batch's program
 identity (`n`, `timesteps`, `scheme`, `path`, `k`, `dtype`), so one
@@ -220,7 +228,8 @@ def hook_from_env(env: Optional[dict] = None):
 SERVE_KINDS = ("compile-fail", "execute-nan", "slow-batch",
                "worker-crash", "conn-drop", "progcache-truncate",
                "progcache-fingerprint", "chunk-crash",
-               "handoff-corrupt")
+               "handoff-corrupt", "resultcache-corrupt",
+               "resultcache-stale-fingerprint")
 
 # Router-tier chaos kinds (full spec names - they keep their prefix,
 # unlike serve specs, because `router-` and `store-` faults fire in

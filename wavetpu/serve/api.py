@@ -105,6 +105,8 @@ _USAGE = (
     "[--solve-state-dir DIR] [--solve-state-ttl-s S] "
     "[--brownout-thresholds P1,P2,P3] [--no-brownout] "
     "[--proxy-token SECRET] [--tenant-inflight-cap N] "
+    "[--result-cache] [--result-cache-max-bytes B] "
+    "[--result-cache-ttl-s S] "
     "[--platform NAME] "
     "[--telemetry-dir DIR] [--record-trace FILE.jsonl] [--version]"
 )
@@ -119,11 +121,12 @@ _KNOWN = (
     "program-cache-max-bytes", "chunk-threshold", "chunk-steps",
     "solve-state-dir", "solve-state-ttl-s",
     "brownout-thresholds", "no-brownout", "proxy-token",
-    "tenant-inflight-cap", "platform",
+    "tenant-inflight-cap", "result-cache",
+    "result-cache-max-bytes", "result-cache-ttl-s", "platform",
     "telemetry-dir", "record-trace", "version",
 )
 _VALUELESS = ("no-errors", "no-watchdog", "no-server-timing",
-              "no-breaker", "no-brownout", "version")
+              "no-breaker", "no-brownout", "result-cache", "version")
 
 
 def _split_flags(argv: Sequence[str]) -> dict:
@@ -335,7 +338,9 @@ class ServerState:
                  max_lane_cells: Optional[int] = None,
                  recorder=None, server_timing: bool = True,
                  fault_plan=None, proxy_token: Optional[str] = None,
-                 tenant_inflight_cap: Optional[int] = None):
+                 tenant_inflight_cap: Optional[int] = None,
+                 result_cache=None,
+                 result_cache_fp_tag: Optional[str] = None):
         self.engine = engine
         self.batcher = batcher
         self.metrics = metrics
@@ -361,6 +366,15 @@ class ServerState:
         self.tenant_inflight_cap = tenant_inflight_cap
         self._tenant_inflight: dict = {}
         self._tenant_lock = threading.Lock()
+        # Content-addressed result cache (serve/resultcache.py; None =
+        # off, the default - tier-1 batching semantics rely on identical
+        # concurrent requests sharing a BATCH, which --result-cache
+        # upgrades to sharing an ANSWER).  `result_cache_fp_tag` is the
+        # short environment-fingerprint hash stamped on store responses
+        # (`X-Wavetpu-Cache: store;fp=TAG`) so the router's edge tier
+        # can flush across fleet upgrades.
+        self.result_cache = result_cache
+        self.result_cache_fp_tag = result_cache_fp_tag
         self.started = time.time()
         self.draining = False
         # Readiness: `warming` is True while the background --warmup
@@ -443,14 +457,20 @@ class _Handler(BaseHTTPRequestHandler):
                 return None
         return st.backend
 
-    def _send(self, code: int, payload: dict,
+    def _send(self, code: int, payload,
               headers: Optional[dict] = None) -> None:
+        if isinstance(payload, (bytes, bytearray)):
+            # A result-cache hit (or a just-stored fresh solve) replays
+            # the EXACT serialized payload - bytes, not a re-encodable
+            # dict - so hits are byte-identical by construction.
+            self._send_raw(code, bytes(payload), "application/json",
+                           headers)
+            return
         self._send_text(code, json.dumps(payload), "application/json",
                         headers)
 
-    def _send_text(self, code: int, text: str, content_type: str,
-                   headers: Optional[dict] = None) -> None:
-        body = text.encode()
+    def _send_raw(self, code: int, body: bytes, content_type: str,
+                  headers: Optional[dict] = None) -> None:
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
@@ -458,6 +478,10 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
+
+    def _send_text(self, code: int, text: str, content_type: str,
+                   headers: Optional[dict] = None) -> None:
+        self._send_raw(code, text.encode(), content_type, headers)
 
     def do_GET(self) -> None:  # noqa: N802 (stdlib contract)
         if self.path == "/healthz":
@@ -534,6 +558,8 @@ class _Handler(BaseHTTPRequestHandler):
             snap = self.state.metrics.snapshot()
             snap["program_cache"] = self.state.engine.cache_stats()
             snap["breaker"] = self.state.engine.breaker_stats()
+            if self.state.result_cache is not None:
+                snap["result_cache"] = self.state.result_cache.snapshot()
             self._send(200, snap)
         else:
             self._send(404, {"status": "error", "error": "not found"})
@@ -742,6 +768,43 @@ class _Handler(BaseHTTPRequestHandler):
             # Accepted traffic only (post-validation, post-limits): the
             # recorded trace replays cleanly instead of re-issuing junk.
             st.recorder.record(body, request_id=rid)
+        # Content-addressed result cache (serve/resultcache.py), probed
+        # BEFORE the batcher: a hit answers without a queue slot, a
+        # tenant in-flight slot, or a march.  Eligibility is
+        # conservative - deterministic full solves only, never a
+        # resume-token request; `Cache-Control: no-cache` opts this
+        # request out of the lookup (counted bypass) while its fresh
+        # answer still refreshes the entry.
+        cache_key = None
+        if st.result_cache is not None and \
+                progkey.result_cache_eligible(body):
+            try:
+                cache_key = progkey.result_key(
+                    body, st.default_kernel, platform=_jax_platform
+                )
+            except ValueError:
+                cache_key = None
+        if cache_key is not None:
+            cc = (self.headers.get("Cache-Control") or "").lower()
+            if "no-cache" in cc:
+                st.result_cache.note_bypass()
+            else:
+                hit = st.result_cache.get(
+                    cache_key,
+                    n=req.problem.N, timesteps=req.problem.timesteps,
+                    scheme=req.scheme, path=req.path, k=req.k,
+                    dtype=req.dtype_name,
+                )
+                if hit is not None:
+                    payload_bytes, _orig_timing = hit
+                    headers = {"X-Wavetpu-Cache": "hit"}
+                    if st.server_timing:
+                        headers["Server-Timing"] = (
+                            f"cache;desc=hit, total;dur="
+                            f"{(time.monotonic() - t0) * 1e3:.3f}"
+                        )
+                    st.metrics.observe_response(True)
+                    return 200, payload_bytes, headers
         if not st.try_acquire_tenant_slot(req.tenant):
             # Defensive per-tenant in-flight cap (--tenant-inflight-cap):
             # the router's token buckets are the authoritative quota;
@@ -765,6 +828,7 @@ class _Handler(BaseHTTPRequestHandler):
             fut = st.batcher.submit(
                 req, request_id=rid, deadline=deadline,
                 trace_context=getattr(self, "_trace_context", None),
+                coalesce_key=cache_key,
             )
         except QueueFullError as e:
             # Bounded-queue backpressure: shed load NOW instead of
@@ -912,11 +976,24 @@ class _Handler(BaseHTTPRequestHandler):
             st.engine.compute_errors and req.lane.c2tau2_field is None
         )
         st.metrics.observe_response(True)
-        return (
-            200,
-            _ok_payload(lane_result, batch_info, errors_computed),
-            headers,
-        )
+        payload = _ok_payload(lane_result, batch_info, errors_computed)
+        if cache_key is None:
+            return 200, payload, headers
+        # Serialize ONCE: the stored entry and this response are the
+        # same bytes, so a later hit is byte-identical by construction.
+        body_bytes = json.dumps(payload).encode()
+        if getattr(fut, "wavetpu_coalesced", False):
+            # A singleflight rider - the primary's answer fanned out to
+            # this request; the primary stores, this one just says so.
+            headers["X-Wavetpu-Cache"] = "coalesced"
+        elif batch_info.get("batched") and \
+                batch_info.get("fallback_reason") is None:
+            if st.result_cache.put(cache_key, body_bytes,
+                                   headers.get("Server-Timing")):
+                headers["X-Wavetpu-Cache"] = (
+                    f"store;fp={st.result_cache_fp_tag or 'none'}"
+                )
+        return 200, body_bytes, headers
 
 
 def build_server(
@@ -950,6 +1027,9 @@ def build_server(
     brownout_thresholds: Sequence[float] = (0.5, 2.0, 8.0),
     proxy_token: Optional[str] = None,
     tenant_inflight_cap: Optional[int] = None,
+    result_cache: bool = False,
+    result_cache_max_bytes: Optional[int] = None,
+    result_cache_ttl_s: Optional[float] = None,
 ) -> Tuple[ThreadingHTTPServer, ServerState]:
     """Assemble engine + batcher + HTTP server (port 0 = ephemeral; the
     bound port is `httpd.server_address[1]`).  Returned httpd is not yet
@@ -979,7 +1059,13 @@ def build_server(
     batch, then defers chunk starts; --no-brownout disables);
     `proxy_token` gates tenant/priority headers to router-stamped
     requests only, and `tenant_inflight_cap` bounds any one tenant's
-    concurrent in-flight solves at this replica."""
+    concurrent in-flight solves at this replica.  `result_cache`
+    (--result-cache, default OFF) turns on the content-addressed
+    result tier (serve/resultcache.py): byte-identical replay of
+    deterministic full-solve answers plus singleflight coalescing of
+    identical in-flight requests, bounded by
+    `result_cache_max_bytes`/`result_cache_ttl_s` and invalidated on
+    environment-fingerprint drift."""
     from wavetpu.obs.registry import MetricsRegistry
     from wavetpu.run import faults
     from wavetpu.serve.engine import ServeEngine
@@ -1021,6 +1107,30 @@ def build_server(
         from wavetpu.loadgen.trace import TraceRecorder
 
         recorder = TraceRecorder(record_trace)
+    rcache = None
+    rcache_fp_tag = None
+    if result_cache:
+        import hashlib
+
+        from wavetpu.serve import progcache as _progcache
+        from wavetpu.serve import resultcache as _resultcache
+
+        # The environment identity entries are valid under (a jaxlib
+        # upgrade invalidates, docs/serving.md "Result cache") -
+        # computed HERE so resultcache.py itself stays jax-free.
+        try:
+            fp = _progcache.env_fingerprint()
+        except Exception:
+            fp = None
+        rcache = _resultcache.ResultCache(
+            max_bytes=(result_cache_max_bytes
+                       or _resultcache.DEFAULT_MAX_BYTES),
+            ttl_s=result_cache_ttl_s or _resultcache.DEFAULT_TTL_S,
+            fingerprint=fp, registry=registry, fault_plan=fault_plan,
+        )
+        rcache_fp_tag = hashlib.sha256(
+            json.dumps(fp, sort_keys=True).encode()
+        ).hexdigest()[:8]
     httpd = ThreadingHTTPServer((host, port), _Handler)
     httpd.wavetpu_state = ServerState(
         engine, batcher, metrics, default_kernel,
@@ -1028,6 +1138,7 @@ def build_server(
         recorder=recorder, server_timing=server_timing,
         fault_plan=fault_plan, proxy_token=proxy_token,
         tenant_inflight_cap=tenant_inflight_cap,
+        result_cache=rcache, result_cache_fp_tag=rcache_fp_tag,
     )
     return httpd, httpd.wavetpu_state
 
@@ -1119,6 +1230,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             int(flags["tenant-inflight-cap"])
             if "tenant-inflight-cap" in flags else None
         )
+        result_cache_max_bytes = (
+            int(flags["result-cache-max-bytes"])
+            if "result-cache-max-bytes" in flags else None
+        )
+        result_cache_ttl_s = (
+            float(flags["result-cache-ttl-s"])
+            if "result-cache-ttl-s" in flags else None
+        )
     except (ValueError, OSError) as e:
         print(f"error: {e}", file=sys.stderr)
         print(_USAGE, file=sys.stderr)
@@ -1153,6 +1272,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         brownout_thresholds=brownout_thresholds,
         proxy_token=flags.get("proxy-token"),
         tenant_inflight_cap=tenant_inflight_cap,
+        result_cache="result-cache" in flags,
+        result_cache_max_bytes=result_cache_max_bytes,
+        result_cache_ttl_s=result_cache_ttl_s,
     )
     if state.engine.progcache is not None:
         pc = state.engine.progcache

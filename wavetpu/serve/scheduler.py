@@ -59,7 +59,7 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import Future
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from wavetpu.core.problem import Problem
 from wavetpu.ensemble.batched import LaneSpec
@@ -316,6 +316,12 @@ class ServeMetrics:
             "were IGNORED for lack of the --proxy-token secret "
             "(request still served, untenanted)",
         )
+        self._coalesced = r.counter(
+            "wavetpu_serve_coalesced_total",
+            "requests that rode an identical in-flight solve via "
+            "singleflight coalescing instead of enqueueing their own "
+            "march (each still counted/charged as a request)",
+        )
         # Drain-rate estimator behind `retry_after_s`: (monotonic end
         # time, lanes completed) per batch, guarded by the registry
         # lock like everything else here.
@@ -392,6 +398,9 @@ class ServeMetrics:
 
     def observe_tenant_spoof_rejected(self) -> None:
         self._tenant_spoof_rejected.inc()
+
+    def observe_coalesced(self) -> None:
+        self._coalesced.inc()
 
     def retry_after_s(self, pending: int, fallback: float = 1.0) -> float:
         """MEASURED backoff hint for 429/503 responses: how long until
@@ -521,6 +530,7 @@ class ServeMetrics:
                 "resumed_total": int(self._resumes.total()),
                 "shed_total": int(self._shed.total()),
                 "brownout_rung": int(self._brownout_rung.value()),
+                "coalesced_total": int(self._coalesced.value()),
             }
 
 
@@ -825,6 +835,15 @@ class DynamicBatcher:
         self._plock = threading.Lock()
         self._closed = False
         self._drain = False
+        # Singleflight coalescing (guarded by _plock): coalesce_key ->
+        # the in-flight primary _Item.  Only populated when the HTTP
+        # layer passes a key (result cache enabled + request eligible);
+        # followers chain onto the primary's future and never enter the
+        # queue.  Entries unregister via a done-callback on the primary
+        # future - every resolution site (worker, close sweep, crash
+        # cleanup) resolves futures OUTSIDE _plock, so the callback's
+        # _plock acquire cannot deadlock.
+        self._singleflight: Dict[str, _Item] = {}
         # The batch the worker currently holds OUTSIDE the queue/stash
         # (supervisor bookkeeping): if the worker crashes mid-batch,
         # these futures must be failed retriable, never stranded.
@@ -908,16 +927,50 @@ class DynamicBatcher:
     def submit(self, request: SolveRequest,
                request_id: Optional[str] = None,
                deadline: Optional[float] = None,
-               trace_context: Optional[Tuple[str, str]] = None) -> Future:
+               trace_context: Optional[Tuple[str, str]] = None,
+               coalesce_key: Optional[str] = None) -> Future:
         """`deadline` is an absolute `time.monotonic()` bound (None =
         unbounded, the historical behavior): the worker drops the item
         with `DeadlineExceededError` if it is still queued past it.
         `trace_context` is the serving span's (trace id, wire span id):
         chunk spans stamp the trace id and checkpoints carry it so
-        resumed marches link back to the originating request."""
+        resumed marches link back to the originating request.
+        `coalesce_key` (the request's content-addressed result key)
+        opts this submit into singleflight: if an identical solve is
+        already in flight its answer fans out to this caller too (the
+        returned future carries `wavetpu_coalesced = True`); otherwise
+        this submit becomes the primary later identical submits ride."""
         request.priority = normalize_priority(
             getattr(request, "priority", None)
         )
+        if coalesce_key is not None:
+            with self._plock:
+                primary = self._singleflight.get(coalesce_key)
+                if primary is not None and not primary.future.done():
+                    follower: Future = Future()
+                    follower.wavetpu_coalesced = True
+
+                    def _fanout(pf: Future, f: Future = follower) -> None:
+                        if f.done():
+                            return
+                        exc = pf.exception()
+                        if exc is not None:
+                            f.set_exception(exc)
+                        else:
+                            f.set_result(pf.result())
+
+                    primary.future.add_done_callback(_fanout)
+                else:
+                    primary = None
+            if primary is not None:
+                # Each coalesced rider is still individually counted
+                # (and, at the router, individually quota-charged): the
+                # fan-out saves the march, not the accounting.
+                self.metrics.observe_coalesced()
+                self.metrics.observe_request()
+                self.metrics.observe_tenant(request.tenant)
+                self.metrics.observe_class_request(request.priority)
+                return follower
         # Brownout ladder: overload sheds lower classes AT ADMISSION
         # (before any queue accounting) with a measured Retry-After -
         # a fast retriable 503, never a slow timeout.
@@ -972,10 +1025,24 @@ class DynamicBatcher:
             self._depth += 1
             self.metrics.observe_queue_depth(self._depth)
             self._q.put(item)
+            if coalesce_key is not None and not chunked:
+                self._singleflight[coalesce_key] = item
+        if coalesce_key is not None and not chunked:
+            # Attached OUTSIDE _plock; fires in whatever thread resolves
+            # the primary (always lock-free at that point, see __init__).
+            item.future.add_done_callback(
+                lambda _f, k=coalesce_key, it=item:
+                self._unregister_singleflight(k, it)
+            )
         self.metrics.observe_request()
         self.metrics.observe_tenant(request.tenant)
         self.metrics.observe_class_request(request.priority)
         return item.future
+
+    def _unregister_singleflight(self, key: str, item: _Item) -> None:
+        with self._plock:
+            if self._singleflight.get(key) is item:
+                del self._singleflight[key]
 
     def close(self, timeout: float = 5.0, drain: bool = False) -> None:
         """Stop the worker.  `drain=True` flushes everything already
