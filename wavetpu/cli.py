@@ -179,7 +179,15 @@ preempted on one replica and resumed on another (docs/observability.md
 (wavetpu/obs/ledger.py): per-ProgramKey compile spend, keys recompiled
 across restarts, a what-if simulation of the persistent AOT cache
 (ROADMAP direction 2), and the warmup-manifest export that direction's
-`wavetpu warmup --manifest` will consume.  `wavetpu profile --out DIR
+`wavetpu warmup --manifest` will consume.
+`wavetpu plan-report TELEMETRY_DIR [--json]
+[--emit-plan-table OUT.json]` joins the accuracy ledger
+(wavetpu/obs/accuracy.py - oracle errors + shadow-solve divergence)
+with the compile ledger and the obs/perf.py roofline model into the
+measured speed-accuracy frontier per (plan, N-bucket): Gcell/s, wall
+s/request, error percentiles, Pareto-dominated plans flagged; the
+emitted plan_table.json is the input ROADMAP direction 4's planner
+consumes.  `wavetpu profile --out DIR
 ARGS...` runs a full wavetpu command line under `jax.profiler` so the
 telemetry spans land inside the device trace, then prints a
 post-capture summary.
@@ -255,6 +263,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from wavetpu.obs import ledger as compile_ledger
 
         return compile_ledger.main(argv[1:])
+    if argv and argv[0] == "plan-report":
+        # Measured speed-accuracy plan table: joins the accuracy ledger
+        # with the compile ledger and the roofline model (stdlib-only
+        # unless the roofline join needs perf constants; never jax).
+        from wavetpu.obs import accuracy as obs_accuracy
+
+        return obs_accuracy.main(argv[1:])
     if argv and argv[0] == "profile":
         # jax.profiler bracket around one solve or a serve window, so
         # the telemetry span annotations land in a device trace.
@@ -410,6 +425,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "wavetpu trace-report [TRACE.jsonl ...] [--dir DIR ...] | "
             "wavetpu loadgen generate|replay|gate [...] | "
             "wavetpu ledger-report DIR [...] | "
+            "wavetpu plan-report DIR [...] | "
             "wavetpu profile --out DIR ARGS... | "
             "wavetpu warmup --manifest MANIFEST.json [...] | "
             "wavetpu --version\n"

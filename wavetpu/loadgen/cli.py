@@ -50,6 +50,14 @@ baseline-less replay when passed explicitly - the chaos smoke's
                                p95-budget-ms.  The isolation drill pins
                                `--tenant-slo victim:error-budget=0`
                                while the aggressor sheds 429s.
+    --error-slo TIER=BUDGET    per-tier MEASURED-ACCURACY gate
+                               (repeatable): the tier's worst
+                               response-sidecar max_abs_error over the
+                               window must exist and stay <= BUDGET -
+                               the error-budget loop closed on real
+                               numbers (--error-slo compensated=1e-4).
+                               Tiers' advisory budgets from the trace
+                               are echoed in the report either way.
 
 `--mix tenants` generates the aggressor-vs-victim QoS trace: a victim
 tenant replaying the scenario mix at interactive priority interleaved
@@ -93,6 +101,23 @@ _TENANT_SLO_KEYS = {
 }
 
 
+def _parse_error_slos(values: Sequence[str]) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for raw in values:
+        tier, eq, val = raw.partition("=")
+        if not (eq and tier):
+            raise ValueError(
+                f"--error-slo wants TIER=BUDGET, got {raw!r}"
+            )
+        try:
+            out[tier] = float(val)
+        except ValueError:
+            raise ValueError(
+                f"--error-slo budget must be a number, got {raw!r}"
+            )
+    return out
+
+
 def _parse_tenant_slos(values: Sequence[str]) -> Dict[str, dict]:
     out: Dict[str, dict] = {}
     for raw in values:
@@ -115,6 +140,8 @@ def _slo_from_flags(flags: dict) -> Dict[str, object]:
             slo[key] = conv(flags[flag])
     if flags.get("tenant-slo"):
         slo["tenant_slos"] = _parse_tenant_slos(flags["tenant-slo"])
+    if flags.get("error-slo"):
+        slo["error_slos"] = _parse_error_slos(flags["error-slo"])
     return slo
 
 
@@ -189,10 +216,11 @@ def _replay(argv: Sequence[str]) -> int:
             argv,
             known=("target", "mode", "concurrency", "speed", "warmup",
                    "timeout", "out", "baseline", "no-preflight",
-                   "retries", "duration", "tenant-slo", "failover")
+                   "retries", "duration", "tenant-slo", "error-slo",
+                   "failover")
             + tuple(_SLO_FLAGS),
             valueless=("no-preflight", "failover"),
-            repeatable=("target", "tenant-slo"),
+            repeatable=("target", "tenant-slo", "error-slo"),
         )
         if len(pos) != 1:
             raise ValueError("replay wants exactly one TRACE.jsonl")
@@ -227,9 +255,17 @@ def _replay(argv: Sequence[str]) -> int:
         return 2
     except ValueError as e:
         return _usage_error(str(e))
+    # Advisory per-tier accuracy budgets from the trace itself (every
+    # record of a tier carries the same error_budget) - echoed next to
+    # the measured max_abs_err in the report's tier rows.
+    budgets: Dict[str, float] = {}
+    for rec in records:
+        if rec.get("error_budget") is not None:
+            budgets.setdefault(rec["scenario"], rec["error_budget"])
     report = lg_report.build_report(
         result, trace_path=pos[0],
         target=targets[0] if len(targets) == 1 else targets,
+        error_budgets=budgets or None,
     )
     lat = report["latency_ms"]
     occ = report["server"]["occupancy_mean"]
@@ -273,6 +309,17 @@ def _replay(argv: Sequence[str]) -> int:
             f"ok {row['ok']}, 429 {row['rejected_429']}, "
             f"errors {row['errors']}, p95 {row['p95_ms']}ms"
         )
+    for tier, row in sorted((report.get("tiers") or {}).items()):
+        # The error-budget loop's human-readable form: measured oracle
+        # error per tier vs the trace's advisory budget.
+        if row.get("max_abs_err") is None:
+            continue
+        budget = row.get("error_budget")
+        print(
+            f"  err {tier}: max_abs_err {row['max_abs_err']:.3e} "
+            f"over {row['measured_requests']} measured"
+            + (f" (budget {budget:.3e})" if budget is not None else "")
+        )
     if "out" in flags:
         with open(flags["out"], "w", encoding="utf-8") as f:
             json.dump(report, f, indent=1, sort_keys=True)
@@ -283,7 +330,7 @@ def _replay(argv: Sequence[str]) -> int:
         k: v for k, v in slo.items()
         if k in ("p99_budget_ms", "error_budget", "reject_budget",
                  "max_cold_compiles", "min_cache_hit_rate",
-                 "tenant_slos")
+                 "tenant_slos", "error_slos")
     }
     if absolute:
         # An explicitly-passed ABSOLUTE SLO gates even without a
@@ -301,8 +348,9 @@ def _replay(argv: Sequence[str]) -> int:
 def _gate(argv: Sequence[str]) -> int:
     try:
         pos, flags = _split_flags(
-            argv, known=("baseline", "tenant-slo") + tuple(_SLO_FLAGS),
-            repeatable=("tenant-slo",),
+            argv, known=("baseline", "tenant-slo", "error-slo")
+            + tuple(_SLO_FLAGS),
+            repeatable=("tenant-slo", "error-slo"),
         )
         if len(pos) != 1:
             raise ValueError("gate wants exactly one REPORT.json")

@@ -49,6 +49,12 @@ DEFAULT_SLO = {
     # sees zero errors while the aggressor eats 429s" check in ONE
     # mixed replay (--tenant-slo victim:error_budget=0).
     "tenant_slos": None,
+    # Per-tier MEASURED-ACCURACY gates: {"TIER": MAX_ABS_ERR} against
+    # the tiers' `max_abs_err` (worst response-sidecar oracle error in
+    # the window) - the error-budget loop's CI form (--error-slo
+    # compensated=1e-4 fails a replay where the flagship scheme's
+    # measured error regressed past its budget).
+    "error_slos": None,
 }
 
 _TIMING_KEYS = ("queue", "compile", "execute", "padding")
@@ -75,9 +81,13 @@ def _delta(after: Dict[str, float], before: Dict[str, float],
 
 def build_report(result, trace_path: Optional[str] = None,
                  target: Optional[str] = None,
-                 meta: Optional[dict] = None) -> dict:
+                 meta: Optional[dict] = None,
+                 error_budgets: Optional[Dict[str, float]] = None) -> dict:
     """One replay -> the loadgen_report.json dict (see module doc).
-    `result` is a runner.ReplayResult."""
+    `result` is a runner.ReplayResult.  `error_budgets` maps scenario
+    tier -> advisory accuracy budget (the trace records' error_budget
+    field); budgets are echoed next to each tier's measured
+    max_abs_err so the report reads as measured-vs-budget."""
     outs = result.outcomes
     n = len(outs)
     ok = sum(1 for o in outs if o.status == 200)
@@ -102,6 +112,21 @@ def build_report(result, trace_path: Optional[str] = None,
             "retried_requests": sum(1 for o in sub if o.attempts > 1),
         }
         row.update(_pcts(t_lat))
+        # Measured accuracy from the response sidecar (the error-budget
+        # loop): the tier's worst oracle error over the window, next to
+        # its advisory budget from the trace.  Both omitted when the
+        # server computed no errors for the tier (c2-field lanes,
+        # --no-errors) so pre-accuracy baselines keep their shape.
+        errs = [
+            o.max_abs_error for o in sub
+            if getattr(o, "max_abs_error", None) is not None
+        ]
+        if errs:
+            row["max_abs_err"] = max(errs)
+            row["measured_requests"] = len(errs)
+        budget = (error_budgets or {}).get(tier)
+        if budget is not None:
+            row["error_budget"] = budget
         st = [o.server_timing for o in sub if o.server_timing]
         if st:
             row["server_timing_mean_ms"] = {
@@ -437,6 +462,30 @@ def gate(report: dict, baseline: Optional[dict] = None,
                      f"tenant {tenant!r} p95 {row['p95_ms']} ms "
                      f"exceeds budget {tslo['p95_budget_ms']} ms")
 
+    # Measured-accuracy gates: the error-budget loop's teeth.  A tier
+    # with an SLO must exist AND have measured errors AND be inside its
+    # budget - "no data" passes nothing (a --no-errors server or a
+    # renamed tier must not silently green the accuracy gate).
+    if cfg["error_slos"]:
+        rows = report.get("tiers") or {}
+        for tier, budget in sorted(cfg["error_slos"].items()):
+            row = rows.get(tier)
+            if row is None:
+                fail(f"err:{tier}", None, budget,
+                     f"tier {tier!r} has an error SLO but no requests "
+                     f"in the report")
+                continue
+            measured = row.get("max_abs_err")
+            if measured is None:
+                fail(f"err:{tier}", None, budget,
+                     f"tier {tier!r} has an error SLO but the replay "
+                     f"measured no errors (server --no-errors, or a "
+                     f"c2-field tier with no oracle)")
+            elif measured > budget:
+                fail(f"err:{tier}", measured, budget,
+                     f"tier {tier!r} measured max_abs_err "
+                     f"{measured:.3e} exceeds budget {budget:.3e}")
+
     if baseline is not None:
         base_p99 = (baseline.get("latency_ms") or {}).get("p99_ms")
         if cfg["p99_regression_pct"] is not None and base_p99 and p99:
@@ -507,6 +556,22 @@ def format_gate(violations: Sequence[dict], report: dict,
             f"{cache.get('coalesced')}, edge {cache.get('edge_hits')}; "
             f"dup rate {report.get('duplicate_rate')!r})"
         )
+    measured_tiers = {
+        tier: row for tier, row in (report.get("tiers") or {}).items()
+        if row.get("max_abs_err") is not None
+    }
+    if measured_tiers:
+        # Measured accuracy vs advisory budget, per tier: the line CI
+        # greps to prove the error-budget loop closed on real numbers.
+        for tier, trow in sorted(measured_tiers.items()):
+            budget = trow.get("error_budget")
+            lines.append(
+                f"  {'err:' + tier:<18} max_abs_err "
+                f"{trow['max_abs_err']:.3e} over "
+                f"{trow.get('measured_requests')} measured"
+                + (f" (budget {budget:.3e})" if budget is not None
+                   else " (no budget)")
+            )
     for section, singular in (("tenants", "tenant"), ("classes", "class")):
         # QoS breakdown: one line per tenant/class so the isolation
         # drill's victim-vs-aggressor split is visible in the gate text.

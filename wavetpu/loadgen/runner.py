@@ -161,6 +161,11 @@ class RequestOutcome:
                            # trace join handle for trace-report)
     tenant: str = ""       # the record's tenant label (QoS traces)
     priority: str = ""     # the record's declared priority class
+    # Measured oracle error from the response sidecar
+    # (report.max_abs_error) - None when the server did not compute
+    # errors (c2-field lane, --no-errors server).  Feeds the report's
+    # per-tier error-budget table and the --error-slo gate.
+    max_abs_error: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -220,6 +225,19 @@ def _qos_headers(rec: dict) -> Dict[str, str]:
     return h
 
 
+def _sidecar_error(payload) -> Optional[float]:
+    """report.max_abs_error from a parsed /solve body (None when the
+    server did not compute errors, or the body is not the sidecar
+    shape - a proxy error page must not kill the replay)."""
+    if not isinstance(payload, dict):
+        return None
+    report = payload.get("report")
+    if not isinstance(report, dict):
+        return None
+    v = report.get("max_abs_error")
+    return float(v) if isinstance(v, (int, float)) else None
+
+
 def _post_one(base_url: str, index: int, rec: dict, rid: str,
               t_sent: float, timeout: float,
               client=None) -> RequestOutcome:
@@ -243,6 +261,7 @@ def _post_one(base_url: str, index: int, rec: dict, rid: str,
             traceparent=out.traceparent,
             tenant=rec.get("tenant", "") or "",
             priority=rec.get("priority", "") or "",
+            max_abs_error=_sidecar_error(out.payload),
         )
     body = json.dumps(rec["body"]).encode()
     traceparent = format_traceparent(mint_trace_id(), mint_span_id())
@@ -256,12 +275,16 @@ def _post_one(base_url: str, index: int, rec: dict, rid: str,
         },
     )
     t0 = time.perf_counter()
-    status, timing, err = 0, {}, None
+    status, timing, err, measured_err = 0, {}, None, None
     try:
         with urllib.request.urlopen(req, timeout=timeout) as r:
-            r.read()
+            raw = r.read()
             status = r.status
             timing = parse_server_timing(r.headers.get("Server-Timing"))
+            try:
+                measured_err = _sidecar_error(json.loads(raw))
+            except (ValueError, TypeError):
+                measured_err = None
     except urllib.error.HTTPError as e:
         status = e.code
         timing = parse_server_timing(e.headers.get("Server-Timing"))
@@ -278,6 +301,7 @@ def _post_one(base_url: str, index: int, rec: dict, rid: str,
         target=base_url.rstrip("/"), traceparent=traceparent,
         tenant=rec.get("tenant", "") or "",
         priority=rec.get("priority", "") or "",
+        max_abs_error=measured_err,
     )
 
 

@@ -482,6 +482,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(json.dumps(agg, indent=1, sort_keys=True))
     else:
         print(format_report(agg))
+        # Companion pointer (quota cost-model carry-over): when the same
+        # telemetry dir also holds an accuracy ledger, `wavetpu
+        # plan-report DIR` joins the two into plan_table.json, whose
+        # MEASURED wall s/request per plan is the drop-in replacement
+        # for the analytic cells pricing fleet/quota.py charges today.
+        if os.path.isdir(path):
+            from wavetpu.obs import accuracy as _accuracy
+
+            acc = os.path.join(path, _accuracy.ACCURACY_FILENAME)
+            if os.path.exists(acc):
+                print(
+                    f"\naccuracy ledger present ({acc}): run `wavetpu "
+                    f"plan-report {path}` for the measured "
+                    f"speed-accuracy plan table; its wall s/request "
+                    f"replaces the analytic cells pricing in "
+                    f"fleet/quota.py"
+                )
     if manifest_out is not None:
         manifest = warmup_manifest(records)
         with open(manifest_out, "w", encoding="utf-8") as f:

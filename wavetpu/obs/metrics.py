@@ -32,6 +32,12 @@ Roofline + device-memory instruments (obs/perf.py owns the catalog):
 `record_solve` also stamps the shared analytic cost model's verdict
 (modeled GB/s, roofline fraction) for the config that ran and samples
 device memory - both host-side arithmetic at solve granularity.
+
+Accuracy instruments (obs/accuracy.py owns the catalog): a solve that
+computed oracle errors additionally stamps
+`wavetpu_solve_max_abs_err{path,scheme,dtype}` plus the per-plan
+log-bucketed `wavetpu_solve_abs_err` histogram and appends one
+accuracy-ledger line under --telemetry-dir.
 """
 
 from __future__ import annotations
@@ -81,6 +87,18 @@ def record_solve(result, path: str, *, scheme: str = "standard",
         "wavetpu_last_solve_gcells_per_s",
         "throughput of the most recent solve", ("path",)
     ).set(float(result.gcells_per_second or 0.0), path=path)
+    # Accuracy observatory (obs/accuracy.py): a solve that computed
+    # errors against the analytic oracle stamps its measured
+    # max_abs_err (gauge + log-bucketed histogram) and appends one
+    # accuracy-ledger line under --telemetry-dir.  Guarded separately
+    # from the roofline block so neither X-ray can starve the other.
+    try:
+        from wavetpu.obs import accuracy
+
+        accuracy.observe_solve(result, path, scheme=scheme, k=k,
+                               with_field=with_field, registry=reg)
+    except Exception:
+        pass
     # Roofline attribution + device-memory sample (obs/perf.py): both a
     # few host-side ops per solve; memory sampling short-circuits after
     # one probe on backends without memory_stats().  Guarded: the X-ray

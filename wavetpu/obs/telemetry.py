@@ -27,6 +27,7 @@ import threading
 import time
 from typing import Optional
 
+from wavetpu.obs import accuracy as accuracy_ledger
 from wavetpu.obs import ledger as compile_ledger
 from wavetpu.obs import tracing
 from wavetpu.obs.registry import MetricsRegistry, get_registry
@@ -64,12 +65,18 @@ class Telemetry:
         self.ledger_path = os.path.join(
             directory, compile_ledger.LEDGER_FILENAME
         )
+        self.accuracy_path = os.path.join(
+            directory, accuracy_ledger.ACCURACY_FILENAME
+        )
         tracing.configure(self.trace_path, max_bytes=max_bytes, keep=keep)
-        # Compile-cost ledger: append-only and deliberately EXEMPT from
-        # the size rotation below - one line per compile, and rotating
-        # away history would defeat the cross-restart accounting
-        # `wavetpu ledger-report` exists for (obs/ledger.py).
+        # Compile-cost + accuracy ledgers: append-only and deliberately
+        # EXEMPT from the size rotation below - one line per compile /
+        # measured solve, and rotating away history would defeat the
+        # cross-restart accounting `wavetpu ledger-report` and
+        # `wavetpu plan-report` exist for (obs/ledger.py,
+        # obs/accuracy.py).
         compile_ledger.configure(self.ledger_path)
+        accuracy_ledger.configure(self.accuracy_path)
         self._stop = threading.Event()
         self._stopped = False
         self._thread = threading.Thread(
@@ -133,6 +140,9 @@ class Telemetry:
         led = compile_ledger.get_ledger()
         if led is not None and led.path == self.ledger_path:
             compile_ledger.disable()
+        acc = accuracy_ledger.get_ledger()
+        if acc is not None and acc.path == self.accuracy_path:
+            accuracy_ledger.disable()
 
 
 def start(directory: str, registry: Optional[MetricsRegistry] = None,

@@ -63,7 +63,12 @@ and HTTP layer consult at their seams -
  * `serve-resultcache-stale-fingerprint[:SELECTOR,count=N]` - one
    result-cache lookup observes a poisoned environment fingerprint
    (the jaxlib-upgrade-under-a-warm-cache drill), driving the
-   cross-version rejection branch the same way.
+   cross-version rejection branch the same way;
+ * `serve-shadow-fail[:SELECTOR,count=N]` - a matching shadow solve
+   (serve/shadow.py, `--shadow-sample-rate`) crashes in its worker
+   before the reference twin runs, proving a shadow failure is
+   counted, never touches the already-sent primary answer, and never
+   feeds the circuit breaker.
 
 SELECTOR is `field=value` pairs matched against the batch's program
 identity (`n`, `timesteps`, `scheme`, `path`, `k`, `dtype`), so one
@@ -229,7 +234,7 @@ SERVE_KINDS = ("compile-fail", "execute-nan", "slow-batch",
                "worker-crash", "conn-drop", "progcache-truncate",
                "progcache-fingerprint", "chunk-crash",
                "handoff-corrupt", "resultcache-corrupt",
-               "resultcache-stale-fingerprint")
+               "resultcache-stale-fingerprint", "shadow-fail")
 
 # Router-tier chaos kinds (full spec names - they keep their prefix,
 # unlike serve specs, because `router-` and `store-` faults fire in

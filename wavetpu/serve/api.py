@@ -107,6 +107,7 @@ _USAGE = (
     "[--proxy-token SECRET] [--tenant-inflight-cap N] "
     "[--result-cache] [--result-cache-max-bytes B] "
     "[--result-cache-ttl-s S] "
+    "[--shadow-sample-rate P] [--shadow-deadline-s S] "
     "[--platform NAME] "
     "[--telemetry-dir DIR] [--record-trace FILE.jsonl] [--version]"
 )
@@ -122,7 +123,8 @@ _KNOWN = (
     "solve-state-dir", "solve-state-ttl-s",
     "brownout-thresholds", "no-brownout", "proxy-token",
     "tenant-inflight-cap", "result-cache",
-    "result-cache-max-bytes", "result-cache-ttl-s", "platform",
+    "result-cache-max-bytes", "result-cache-ttl-s",
+    "shadow-sample-rate", "shadow-deadline-s", "platform",
     "telemetry-dir", "record-trace", "version",
 )
 _VALUELESS = ("no-errors", "no-watchdog", "no-server-timing",
@@ -340,7 +342,8 @@ class ServerState:
                  fault_plan=None, proxy_token: Optional[str] = None,
                  tenant_inflight_cap: Optional[int] = None,
                  result_cache=None,
-                 result_cache_fp_tag: Optional[str] = None):
+                 result_cache_fp_tag: Optional[str] = None,
+                 shadow=None):
         self.engine = engine
         self.batcher = batcher
         self.metrics = metrics
@@ -375,6 +378,11 @@ class ServerState:
         # can flush across fleet upgrades.
         self.result_cache = result_cache
         self.result_cache_fp_tag = result_cache_fp_tag
+        # Shadow-solve sampler (serve/shadow.py; None = off, the
+        # default): a sampled fraction of eligible /solve responses is
+        # re-solved off the hot path with the compensated-f32 reference
+        # plan and the measured divergence ledgered (obs/accuracy.py).
+        self.shadow = shadow
         self.started = time.time()
         self.draining = False
         # Readiness: `warming` is True while the background --warmup
@@ -560,6 +568,8 @@ class _Handler(BaseHTTPRequestHandler):
             snap["breaker"] = self.state.engine.breaker_stats()
             if self.state.result_cache is not None:
                 snap["result_cache"] = self.state.result_cache.snapshot()
+            if self.state.shadow is not None:
+                snap["shadow"] = self.state.shadow.snapshot()
             self._send(200, snap)
         else:
             self._send(404, {"status": "error", "error": "not found"})
@@ -634,6 +644,11 @@ class _Handler(BaseHTTPRequestHandler):
         # slot it took here; releasing in THIS finally covers every
         # return path (including handler exceptions).
         self._tenant_slot: Optional[str] = None
+        # Shadow-solve sampling: _handle_solve stashes (request,
+        # lane_result) for an eligible 200 here; the offer happens
+        # AFTER _send below, so the primary answer is on the wire
+        # before any shadow work exists.
+        self._shadow_offer = None
         try:
             code, payload, headers = self._handle_solve(rid)
         finally:
@@ -649,6 +664,13 @@ class _Handler(BaseHTTPRequestHandler):
         if echo_tp:
             headers.setdefault("traceparent", echo_tp)
         self._send(code, payload, headers)
+        offer = self._shadow_offer
+        if offer is not None and self.state.shadow is not None:
+            req, lane_result = offer
+            self.state.shadow.offer(
+                req, lane_result, rid,
+                trace_context=getattr(self, "_trace_context", None),
+            )
 
     def _handle_solve(self, rid) -> Tuple[int, dict, dict]:
         from wavetpu.serve.resilience import (
@@ -976,6 +998,10 @@ class _Handler(BaseHTTPRequestHandler):
             st.engine.compute_errors and req.lane.c2tau2_field is None
         )
         st.metrics.observe_response(True)
+        if st.shadow is not None and not getattr(req, "shadow", False):
+            # Offered after the response is sent (do_POST); the sampler
+            # does its own eligibility/rate/busy checks there.
+            self._shadow_offer = (req, lane_result)
         payload = _ok_payload(lane_result, batch_info, errors_computed)
         if cache_key is None:
             return 200, payload, headers
@@ -1030,6 +1056,8 @@ def build_server(
     result_cache: bool = False,
     result_cache_max_bytes: Optional[int] = None,
     result_cache_ttl_s: Optional[float] = None,
+    shadow_sample_rate: float = 0.0,
+    shadow_deadline_s: float = 120.0,
 ) -> Tuple[ThreadingHTTPServer, ServerState]:
     """Assemble engine + batcher + HTTP server (port 0 = ephemeral; the
     bound port is `httpd.server_address[1]`).  Returned httpd is not yet
@@ -1065,7 +1093,12 @@ def build_server(
     deterministic full-solve answers plus singleflight coalescing of
     identical in-flight requests, bounded by
     `result_cache_max_bytes`/`result_cache_ttl_s` and invalidated on
-    environment-fingerprint drift."""
+    environment-fingerprint drift.  `shadow_sample_rate`
+    (--shadow-sample-rate, default 0 = off) re-solves that fraction of
+    eligible /solve responses off the hot path with the
+    compensated-f32 reference plan and ledgers the measured divergence
+    (serve/shadow.py, obs/accuracy.py); `shadow_deadline_s` caps each
+    shadow's scheduler budget."""
     from wavetpu.obs.registry import MetricsRegistry
     from wavetpu.run import faults
     from wavetpu.serve.engine import ServeEngine
@@ -1131,6 +1164,14 @@ def build_server(
         rcache_fp_tag = hashlib.sha256(
             json.dumps(fp, sort_keys=True).encode()
         ).hexdigest()[:8]
+    shadow = None
+    if shadow_sample_rate > 0.0:
+        from wavetpu.serve.shadow import ShadowSampler
+
+        shadow = ShadowSampler(
+            batcher, registry, shadow_sample_rate,
+            fault_plan=fault_plan, deadline_s=shadow_deadline_s,
+        )
     httpd = ThreadingHTTPServer((host, port), _Handler)
     httpd.wavetpu_state = ServerState(
         engine, batcher, metrics, default_kernel,
@@ -1139,6 +1180,7 @@ def build_server(
         fault_plan=fault_plan, proxy_token=proxy_token,
         tenant_inflight_cap=tenant_inflight_cap,
         result_cache=rcache, result_cache_fp_tag=rcache_fp_tag,
+        shadow=shadow,
     )
     return httpd, httpd.wavetpu_state
 
@@ -1238,6 +1280,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             float(flags["result-cache-ttl-s"])
             if "result-cache-ttl-s" in flags else None
         )
+        shadow_sample_rate = float(flags.get("shadow-sample-rate", "0"))
+        if not 0.0 <= shadow_sample_rate <= 1.0:
+            raise ValueError(
+                "--shadow-sample-rate must be in [0, 1], got "
+                f"{shadow_sample_rate}"
+            )
+        shadow_deadline_s = float(flags.get("shadow-deadline-s", "120"))
     except (ValueError, OSError) as e:
         print(f"error: {e}", file=sys.stderr)
         print(_USAGE, file=sys.stderr)
@@ -1275,6 +1324,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         result_cache="result-cache" in flags,
         result_cache_max_bytes=result_cache_max_bytes,
         result_cache_ttl_s=result_cache_ttl_s,
+        shadow_sample_rate=shadow_sample_rate,
+        shadow_deadline_s=shadow_deadline_s,
     )
     if state.engine.progcache is not None:
         pc = state.engine.progcache
@@ -1286,6 +1337,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(f"program cache: {pc.directory} [{mode}]")
     if state.recorder is not None:
         print(f"recording accepted /solve traffic: {flags['record-trace']}")
+    if state.shadow is not None:
+        print(
+            f"shadow sampling: rate={state.shadow.rate} "
+            f"deadline_s={state.shadow.deadline_s}"
+        )
     telemetry = None
     serving = False
     try:

@@ -54,6 +54,7 @@ from typing import List, Optional, Sequence, Tuple
 from wavetpu.core.problem import Problem
 from wavetpu.ensemble import batched as ensemble
 from wavetpu.ensemble import sharded as ens_sharded
+from wavetpu.obs import accuracy
 from wavetpu.obs import ledger as compile_ledger
 from wavetpu.obs import perf, tracing
 from wavetpu.obs.registry import MetricsRegistry
@@ -686,6 +687,7 @@ class ServeEngine:
         dtype_name: str = "f32",
         mesh: Optional[Tuple[int, int, int]] = None,
         timing: Optional[dict] = None,
+        feed_breaker: bool = True,
     ) -> Tuple[ensemble.EnsembleResult, List[Optional[str]]]:
         """Pad to the bucket, run the cached program (or the recorded
         fallback), watchdog each lane; returns (EnsembleResult,
@@ -694,7 +696,12 @@ class ServeEngine:
         in place with `compile_seconds` (this call's cache-miss compile,
         0.0 warm) and `warm` ("true"/"false"/"fallback") - the
         scheduler threads it into each response's Server-Timing header
-        without changing this method's return contract."""
+        without changing this method's return contract.
+        `feed_breaker=False` (a batch of only shadow-solve lanes,
+        serve/shadow.py) skips the circuit breaker entirely - neither
+        admitted against an open key nor recorded on failure, so the
+        off-hot-path accuracy sampler can never quarantine a program
+        production traffic depends on."""
         lanes = list(lanes)
         with_field = any(lane.c2tau2_field is not None for lane in lanes)
         compute_errors = self.compute_errors and not with_field
@@ -706,7 +713,7 @@ class ServeEngine:
         # watchdog trips are CLIENT errors (a Courant-unstable request)
         # and never feed the breaker.
         bkey = None
-        if self.breaker is not None:
+        if self.breaker is not None and feed_breaker:
             bkey = self.breaker_key(
                 problem, scheme, path, k, dtype_name, with_field, mesh
             )
@@ -801,10 +808,10 @@ class ServeEngine:
         except QuarantinedError:
             raise
         except Exception as e:
-            if self.breaker is not None:
+            if self.breaker is not None and bkey is not None:
                 self.breaker.record_failure(bkey, e)
             raise
-        if self.breaker is not None:
+        if self.breaker is not None and bkey is not None:
             self.breaker.record_success(bkey)
         self._h_execute.observe(result.solve_seconds, warm=warm_label)
         if not result.batched and result.fallback_reason:
@@ -829,4 +836,18 @@ class ServeEngine:
                     r.u_cur = np.full(
                         np.shape(r.u_cur), np.nan, np.float32
                     )
-        return result, self.lane_health(result)
+        verdicts = self.lane_health(result)
+        # Accuracy observatory: every HEALTHY lane that computed oracle
+        # errors stamps its measured max_abs_err and appends one
+        # accuracy-ledger line (obs/accuracy.py) - rides the watchdog
+        # reduction so the per-lane error arrays are read exactly once.
+        # Guarded: the X-ray must never fail the batch it measures.
+        if compute_errors:
+            try:
+                accuracy.observe_serve_batch(
+                    result, verdicts, scheme=scheme, k=k,
+                    dtype=dtype_name, registry=self.registry,
+                )
+            except Exception:
+                pass
+        return result, verdicts

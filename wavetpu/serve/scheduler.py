@@ -132,6 +132,12 @@ class SolveRequest:
     # the brownout shed order - never the program identity, so classes
     # still coalesce into one batch when their keys match.
     priority: str = DEFAULT_PRIORITY
+    # Shadow-solve sampling (serve/shadow.py): True marks the off-hot-
+    # path reference twin of a sampled production request.  Never part
+    # of the program identity - a shadow coalesces into a production
+    # batch of the same key (a free ride) - but a batch of ONLY
+    # shadows runs with the circuit breaker bypassed.
+    shadow: bool = False
 
     def bucket_key(self) -> Tuple:
         """Everything the compiled program identity depends on; only
@@ -1394,6 +1400,14 @@ class DynamicBatcher:
             tenant=req0.tenant,
         )
         timing: dict = {}
+        # A batch of ONLY shadow-solve lanes (serve/shadow.py) must
+        # never feed the circuit breaker; one production lane in the
+        # batch restores the normal contract.  The kwarg is passed only
+        # in the shadow-only case so engine stand-ins with the plain
+        # production signature keep working.
+        solve_kw: dict = {}
+        if all(item.request.shadow for item in batch):
+            solve_kw["feed_breaker"] = False
         # Tenant attribution is thread-local (the worker thread, not the
         # handler thread, runs compiles): any ledger line the engine
         # records during this solve carries the batch leader's tenant.
@@ -1404,7 +1418,7 @@ class DynamicBatcher:
                 [item.request.lane for item in batch],
                 scheme=req0.scheme, path=req0.path, k=req0.k,
                 dtype_name=req0.dtype_name, mesh=req0.mesh_shape,
-                timing=timing,
+                timing=timing, **solve_kw,
             )
         except Exception as e:
             tracing.end_span(span, error=str(e))
